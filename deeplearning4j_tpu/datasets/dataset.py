@@ -1,0 +1,82 @@
+"""DataSet / MultiDataSet containers.
+
+Parity with ND4J's `org.nd4j.linalg.dataset.DataSet` (features, labels,
+featuresMask, labelsMask) and `api.MultiDataSet` (multi-input/multi-output),
+consumed throughout the reference (e.g. MultiLayerNetwork.java:1461).
+Arrays are numpy on the host; the jitted train step moves them to HBM.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class DataSet:
+    def __init__(self, features, labels, features_mask=None, labels_mask=None):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        return (DataSet(self.features[:n_train], self.labels[:n_train],
+                        None if self.features_mask is None else self.features_mask[:n_train],
+                        None if self.labels_mask is None else self.labels_mask[:n_train]),
+                DataSet(self.features[n_train:], self.labels[n_train:],
+                        None if self.features_mask is None else self.features_mask[n_train:],
+                        None if self.labels_mask is None else self.labels_mask[n_train:]))
+
+    def shuffle(self, seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        n = self.num_examples()
+        for i in range(0, n, batch_size):
+            out.append(DataSet(
+                self.features[i:i + batch_size], self.labels[i:i + batch_size],
+                None if self.features_mask is None else self.features_mask[i:i + batch_size],
+                None if self.labels_mask is None else self.labels_mask[i:i + batch_size]))
+        return out
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        f = np.concatenate([d.features for d in datasets])
+        l = np.concatenate([d.labels for d in datasets])
+        fm = (np.concatenate([d.features_mask for d in datasets])
+              if datasets[0].features_mask is not None else None)
+        lm = (np.concatenate([d.labels_mask for d in datasets])
+              if datasets[0].labels_mask is not None else None)
+        return DataSet(f, l, fm, lm)
+
+    def copy(self) -> "DataSet":
+        return DataSet(self.features.copy(), self.labels.copy(),
+                       None if self.features_mask is None else self.features_mask.copy(),
+                       None if self.labels_mask is None else self.labels_mask.copy())
+
+
+class MultiDataSet:
+    """Multiple input/output arrays (reference org.nd4j.linalg.dataset.api.MultiDataSet)."""
+
+    def __init__(self, features: Sequence, labels: Sequence,
+                 features_masks=None, labels_masks=None):
+        self.features = [np.asarray(f) for f in features]
+        self.labels = [np.asarray(l) for l in labels]
+        self.features_masks = (None if features_masks is None
+                               else [None if m is None else np.asarray(m) for m in features_masks])
+        self.labels_masks = (None if labels_masks is None
+                             else [None if m is None else np.asarray(m) for m in labels_masks])
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

@@ -1,0 +1,177 @@
+"""Dataset fetchers + iterators: Iris, MNIST, CIFAR-10.
+
+Parity with the reference `datasets/fetchers/*` + `datasets/iterator/impl/*`
+(MnistDataFetcher:43 with auto-download :68, IrisDataFetcher,
+CifarDataSetIterator:23) and the IDX readers under `datasets/mnist/`.
+
+Offline-first: MNIST/CIFAR load from local files when present
+(`DL4J_TPU_DATA_DIR`, default ~/.dl4j_tpu_data); MNIST falls back to the
+bundled sklearn 8x8 digits upscaled to 28x28, CIFAR to a deterministic
+synthetic set — keeping convergence tests runnable with zero egress.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .dataset import DataSet
+from .iterators import ListDataSetIterator
+
+
+def data_dir() -> Path:
+    return Path(os.environ.get("DL4J_TPU_DATA_DIR", Path.home() / ".dl4j_tpu_data"))
+
+
+def one_hot(labels: np.ndarray, n_classes: int) -> np.ndarray:
+    out = np.zeros((labels.shape[0], n_classes), np.float32)
+    out[np.arange(labels.shape[0]), labels.astype(int)] = 1.0
+    return out
+
+
+# -- IDX format (reference datasets/mnist/MnistDbFile + friends) ---------------
+
+def read_idx(path: Path) -> np.ndarray:
+    """Read an IDX-format file (optionally gzipped)."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0:
+            raise ValueError(f"{path}: bad IDX magic")
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dtype = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+                 0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}[dtype_code]
+        data = np.frombuffer(f.read(), dtype=dtype.newbyteorder(">"))
+        return data.reshape(dims)
+
+
+# -- Iris ----------------------------------------------------------------------
+
+def load_iris_dataset(shuffle_seed: Optional[int] = 12345) -> DataSet:
+    from sklearn.datasets import load_iris
+
+    d = load_iris()
+    x = d.data.astype(np.float32)
+    # per-feature standardization (reference IrisDataFetcher normalizes)
+    x = (x - x.mean(axis=0)) / x.std(axis=0)
+    y = one_hot(d.target, 3)
+    ds = DataSet(x, y)
+    if shuffle_seed is not None:
+        ds.shuffle(shuffle_seed)
+    return ds
+
+
+class IrisDataSetIterator(ListDataSetIterator):
+    """Reference datasets/iterator/impl/IrisDataSetIterator."""
+
+    def __init__(self, batch: int = 150, num_examples: int = 150, seed: int = 12345):
+        ds = load_iris_dataset(seed)
+        ds = DataSet(ds.features[:num_examples], ds.labels[:num_examples])
+        super().__init__(ds, batch)
+
+
+# -- MNIST ---------------------------------------------------------------------
+
+_MNIST_FILES = {
+    "train_images": ("train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"),
+    "train_labels": ("train-labels-idx1-ubyte", "train-labels-idx1-ubyte.gz"),
+    "test_images": ("t10k-images-idx3-ubyte", "t10k-images-idx3-ubyte.gz"),
+    "test_labels": ("t10k-labels-idx1-ubyte", "t10k-labels-idx1-ubyte.gz"),
+}
+
+
+def _find_mnist(train: bool) -> Optional[Tuple[Path, Path]]:
+    base = data_dir() / "mnist"
+    img_key = "train_images" if train else "test_images"
+    lab_key = "train_labels" if train else "test_labels"
+    for img_name in _MNIST_FILES[img_key]:
+        for lab_name in _MNIST_FILES[lab_key]:
+            ip, lp = base / img_name, base / lab_name
+            if ip.exists() and lp.exists():
+                return ip, lp
+    return None
+
+
+def _digits_as_mnist(num: int, train: bool, binarize: bool) -> DataSet:
+    """Bundled sklearn 8x8 digits upscaled to 28x28 — offline MNIST stand-in."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x8 = d.images.astype(np.float32) / 16.0  # [N, 8, 8]
+    # split deterministically: last 297 test, first 1500 train
+    if train:
+        x8, y = x8[:1500], d.target[:1500]
+    else:
+        x8, y = x8[1500:], d.target[1500:]
+    reps = int(np.ceil(num / x8.shape[0]))
+    x8 = np.tile(x8, (reps, 1, 1))[:num]
+    y = np.tile(y, reps)[:num]
+    # 8x8 -> 24x24 by pixel repetition, pad to 28x28
+    x28 = np.pad(x8.repeat(3, axis=1).repeat(3, axis=2), ((0, 0), (2, 2), (2, 2)))
+    if binarize:
+        x28 = (x28 > 0.5).astype(np.float32)
+    return DataSet(x28.reshape(num, 784), one_hot(y, 10))
+
+
+def load_mnist(num: int = 60000, train: bool = True, binarize: bool = False) -> DataSet:
+    found = _find_mnist(train)
+    if found is None:
+        return _digits_as_mnist(num, train, binarize)
+    images = read_idx(found[0]).astype(np.float32) / 255.0
+    labels = read_idx(found[1])
+    images, labels = images[:num], labels[:num]
+    if binarize:
+        images = (images > 0.5).astype(np.float32)
+    return DataSet(images.reshape(images.shape[0], 784), one_hot(labels, 10))
+
+
+class MnistDataSetIterator(ListDataSetIterator):
+    """Reference datasets/iterator/impl/MnistDataSetIterator:30."""
+
+    def __init__(self, batch: int, num_examples: int = 60000, binarize: bool = False,
+                 train: bool = True, shuffle: bool = True, seed: int = 123):
+        ds = load_mnist(num_examples, train, binarize)
+        if shuffle:
+            ds.shuffle(seed)
+        super().__init__(ds, batch)
+
+
+# -- CIFAR-10 ------------------------------------------------------------------
+
+def load_cifar10(num: int = 50000, train: bool = True) -> DataSet:
+    """CIFAR-10 from local python-format batches, else deterministic synthetic
+    32x32x3 class-structured data (keeps AlexNet benchmarks runnable offline)."""
+    base = data_dir() / "cifar-10-batches-py"
+    files = ([base / f"data_batch_{i}" for i in range(1, 6)] if train
+             else [base / "test_batch"])
+    if all(f.exists() for f in files):
+        import pickle
+
+        xs, ys = [], []
+        for f in files:
+            with open(f, "rb") as fh:
+                d = pickle.load(fh, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.float32) / 255.0)
+            ys.append(np.asarray(d[b"labels"]))
+        x = np.concatenate(xs)[:num]
+        y = np.concatenate(ys)[:num]
+        # stored as [N, 3*1024] channel-major; to NHWC
+        x = x.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return DataSet(x.reshape(x.shape[0], -1), one_hot(y, 10))
+    rng = np.random.default_rng(7)
+    y = rng.integers(0, 10, num)
+    # class-dependent colored blobs + noise: learnable but nontrivial
+    base_img = rng.normal(0, 1, (10, 32, 32, 3)).astype(np.float32)
+    x = base_img[y] * 0.5 + rng.normal(0, 0.5, (num, 32, 32, 3)).astype(np.float32)
+    return DataSet(x.reshape(num, -1), one_hot(y, 10))
+
+
+class CifarDataSetIterator(ListDataSetIterator):
+    """Reference datasets/iterator/impl/CifarDataSetIterator:23."""
+
+    def __init__(self, batch: int, num_examples: int = 50000, train: bool = True):
+        super().__init__(load_cifar10(num_examples, train), batch)

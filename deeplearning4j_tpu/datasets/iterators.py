@@ -1,0 +1,238 @@
+"""DataSetIterator stack: list/ndarray-backed, multi-epoch, sampling, async prefetch.
+
+Parity with the reference `datasets/iterator/*`:
+  - `DataSetIterator` SPI (batch(), reset(), iteration protocol)
+  - `ListDataSetIterator`, `INDArrayDataSetIterator` equivalents
+  - `MultipleEpochsIterator:35`
+  - `SamplingDataSetIterator`
+  - `AsyncDataSetIterator:30` — background prefetch thread + BlockingQueue
+    (:32) with device affinity (:58-59). TPU version prefetches host batches
+    on a worker thread so host->HBM transfer overlaps the previous step's
+    compute (double buffering).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator SPI. Subclasses implement next_batch() and reset()."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        ds = self.next_batch()
+        if ds is None:
+            raise StopIteration
+        return ds
+
+    def next_batch(self) -> Optional[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a list of examples in minibatches (reference ListDataSetIterator)."""
+
+    def __init__(self, data: DataSet, batch: int = 10, pad_last: bool = False):
+        self._data = data
+        self._batch = batch
+        self._pos = 0
+        # pad the final partial batch to a full one (static shapes keep a
+        # single XLA compilation; padded rows get zero masks)
+        self._pad_last = pad_last
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def next_batch(self) -> Optional[DataSet]:
+        n = self._data.num_examples()
+        if self._pos >= n:
+            return None
+        end = min(self._pos + self._batch, n)
+        ds = DataSet(
+            self._data.features[self._pos:end],
+            self._data.labels[self._pos:end],
+            None if self._data.features_mask is None else self._data.features_mask[self._pos:end],
+            None if self._data.labels_mask is None else self._data.labels_mask[self._pos:end],
+        )
+        self._pos = end
+        if self._pad_last and ds.num_examples() < self._batch:
+            pad = self._batch - ds.num_examples()
+            ds = DataSet(
+                np.concatenate([ds.features, np.zeros((pad,) + ds.features.shape[1:],
+                                                      ds.features.dtype)]),
+                np.concatenate([ds.labels, np.zeros((pad,) + ds.labels.shape[1:],
+                                                    ds.labels.dtype)]),
+            )
+        return ds
+
+
+class INDArrayDataSetIterator(ListDataSetIterator):
+    """ndarray-pair-backed iterator (reference INDArrayDataSetIterator)."""
+
+    def __init__(self, features, labels, batch: int = 10):
+        super().__init__(DataSet(features, labels), batch)
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replay an underlying iterator for N epochs (reference MultipleEpochsIterator:35)."""
+
+    def __init__(self, epochs: int, underlying: DataSetIterator):
+        self._epochs = epochs
+        self._under = underlying
+        self._epoch = 0
+
+    def batch_size(self) -> int:
+        return self._under.batch_size()
+
+    def reset(self) -> None:
+        self._epoch = 0
+        self._under.reset()
+
+    def next_batch(self) -> Optional[DataSet]:
+        ds = self._under.next_batch()
+        if ds is not None:
+            return ds
+        self._epoch += 1
+        if self._epoch >= self._epochs:
+            return None
+        self._under.reset()
+        return self._under.next_batch()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample minibatches with replacement (reference SamplingDataSetIterator)."""
+
+    def __init__(self, data: DataSet, batch: int, total_batches: int, seed: int = 42):
+        self._data = data
+        self._batch = batch
+        self._total = total_batches
+        self._seed = seed
+        self._count = 0
+        self._rng = np.random.default_rng(seed)
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def reset(self) -> None:
+        self._count = 0
+        self._rng = np.random.default_rng(self._seed)
+
+    def next_batch(self) -> Optional[DataSet]:
+        if self._count >= self._total:
+            return None
+        idx = self._rng.integers(0, self._data.num_examples(), self._batch)
+        self._count += 1
+        return DataSet(self._data.features[idx], self._data.labels[idx])
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (reference AsyncDataSetIterator:30).
+
+    A worker thread pulls batches from the underlying iterator into a bounded
+    queue; the training loop overlaps host-side data prep with device compute.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 2):
+        self._under = underlying
+        self._size = max(1, queue_size)
+        self._queue: "queue.Queue" = queue.Queue(self._size)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._start()
+
+    def _start(self):
+        self._queue = queue.Queue(self._size)
+        self._error = None
+
+        def worker():
+            try:
+                while True:
+                    ds = self._under.next_batch()
+                    self._queue.put(self._SENTINEL if ds is None else ds)
+                    if ds is None:
+                        return
+            except BaseException as e:  # surfaced on the consumer thread
+                self._error = e
+                self._queue.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def batch_size(self) -> int:
+        return self._under.batch_size()
+
+    def reset(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            # drain so the worker can exit
+            while self._thread.is_alive():
+                try:
+                    self._queue.get(timeout=0.01)
+                except queue.Empty:
+                    pass
+            self._thread.join(timeout=1.0)
+        self._under.reset()
+        self._start()
+
+    def next_batch(self) -> Optional[DataSet]:
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._error is not None:
+                raise self._error
+            return None
+        return item
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Rebatch a plain python iterable of DataSets to a fixed minibatch size
+    (reference spark/iterator/IteratorDataSetIterator used by
+    ExecuteWorkerFlatMap:58)."""
+
+    def __init__(self, source: Sequence[DataSet], batch: int):
+        self._source = list(source)
+        self._batch = batch
+        self._pos = 0
+        self._buffer: List[DataSet] = []
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def reset(self) -> None:
+        self._pos = 0
+        self._buffer = []
+
+    def next_batch(self) -> Optional[DataSet]:
+        have = sum(d.num_examples() for d in self._buffer)
+        while have < self._batch and self._pos < len(self._source):
+            d = self._source[self._pos]
+            self._pos += 1
+            self._buffer.append(d)
+            have += d.num_examples()
+        if not self._buffer:
+            return None
+        merged = DataSet.merge(self._buffer)
+        if merged.num_examples() <= self._batch:
+            self._buffer = []
+            return merged
+        out, rest = merged.split_test_and_train(self._batch)
+        self._buffer = [rest]
+        return out

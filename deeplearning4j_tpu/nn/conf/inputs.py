@@ -1,0 +1,91 @@
+"""Input type descriptors for automatic shape inference.
+
+Parity with the reference `nn/conf/inputs/InputType` (feedForward / recurrent /
+convolutional / convolutionalFlat) consumed by the ConvolutionLayerSetup-style
+auto-configuration (reference nn/conf/layers/setup/ConvolutionLayerSetup.java:37).
+
+TPU-first layout conventions (differ deliberately from the reference):
+  - feedforward:    [batch, size]
+  - recurrent:      [batch, time, size]      (reference uses [batch, size, time])
+  - convolutional:  [batch, height, width, channels]  NHWC (reference is NCHW)
+NHWC + trailing feature dim keeps the innermost (lane) dimension a multiple of
+the TPU's 128-wide vector lanes for typical channel counts and lets XLA tile
+matmuls/convs onto the MXU without transposes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from .serde import register
+
+
+@dataclass
+class InputType:
+    kind: str = "feedforward"
+
+    @staticmethod
+    def feed_forward(size: int) -> "FeedForwardInputType":
+        return FeedForwardInputType(size=size)
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "RecurrentInputType":
+        return RecurrentInputType(size=size, timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "ConvolutionalInputType":
+        return ConvolutionalInputType(height=height, width=width, channels=channels)
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "ConvolutionalFlatInputType":
+        return ConvolutionalFlatInputType(height=height, width=width, channels=channels)
+
+
+@register
+@dataclass
+class FeedForwardInputType(InputType):
+    kind: str = "feedforward"
+    size: int = 0
+
+    def flat_size(self) -> int:
+        return self.size
+
+
+@register
+@dataclass
+class RecurrentInputType(InputType):
+    kind: str = "recurrent"
+    size: int = 0
+    timesteps: Optional[int] = None
+
+    def flat_size(self) -> int:
+        return self.size
+
+
+@register
+@dataclass
+class ConvolutionalInputType(InputType):
+    kind: str = "convolutional"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def flat_size(self) -> int:
+        return self.height * self.width * self.channels
+
+    def hwc(self) -> Tuple[int, int, int]:
+        return (self.height, self.width, self.channels)
+
+
+@register
+@dataclass
+class ConvolutionalFlatInputType(InputType):
+    """Flattened image input (e.g. raw MNIST rows of 784)."""
+
+    kind: str = "convolutional_flat"
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def flat_size(self) -> int:
+        return self.height * self.width * self.channels

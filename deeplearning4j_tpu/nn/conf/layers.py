@@ -1,0 +1,331 @@
+"""Layer config taxonomy — the serializable layer DSL.
+
+Capability parity with the reference's 19 layer-config classes under
+`nn/conf/layers/*` (deeplearning4j-core; SURVEY.md §2.2 'Config DSL + serde'):
+Dense, Convolution, Subsampling, BatchNormalization, LRN, GravesLSTM,
+GravesBidirectionalLSTM, GRU, RBM, AutoEncoder, Embedding, Activation,
+Dropout, Output, RnnOutput (+ GlobalPooling and Loss layers).
+
+Configs are pure data (registered for JSON/YAML round-trip). Unset fields
+(None) inherit net-level defaults at build time — mirroring the reference's
+`NeuralNetConfiguration.Builder.layer(...)` global->layer resolution.
+Each config also implements `get_output_type(input_type)` for the
+ConvolutionLayerSetup-style automatic shape inference, and `set_n_in` so the
+builder can wire n_in from upstream output shapes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from .inputs import (ConvolutionalInputType, FeedForwardInputType, InputType,
+                     RecurrentInputType)
+from .serde import register
+from ..updater.updaters import UpdaterConfig
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+@dataclass
+class Layer:
+    """Abstract base layer config; every field may be None = inherit."""
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    dist: Optional[Any] = None
+    dropout: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    bias_init: Optional[float] = None
+    learning_rate: Optional[float] = None
+    bias_learning_rate: Optional[float] = None
+    updater: Optional[UpdaterConfig] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    # -- shape inference hooks -------------------------------------------------
+    def set_n_in(self, input_type: InputType) -> None:
+        """Set this layer's fan-in from the upstream output type (no-op default)."""
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def is_pretrain_layer(self) -> bool:
+        return False
+
+    def clone(self) -> "Layer":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class FeedForwardLayer(Layer):
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.flat_size()
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, RecurrentInputType):
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+
+@register
+@dataclass
+class DenseLayer(FeedForwardLayer):
+    """Fully connected layer (reference nn/conf/layers/DenseLayer.java)."""
+
+
+@register
+@dataclass
+class OutputLayer(FeedForwardLayer):
+    """Output layer with loss (reference nn/conf/layers/OutputLayer.java)."""
+
+    loss: str = "negativeloglikelihood"
+
+
+@register
+@dataclass
+class RnnOutputLayer(FeedForwardLayer):
+    """Per-timestep output layer (reference nn/conf/layers/RnnOutputLayer.java)."""
+
+    loss: str = "mcxent"
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps if isinstance(input_type, RecurrentInputType) else None
+        return InputType.recurrent(self.n_out, ts)
+
+
+@register
+@dataclass
+class LossLayer(Layer):
+    """Loss-only layer, no params (reference LossLayer)."""
+
+    loss: str = "mse"
+
+
+@register
+@dataclass
+class ConvolutionLayer(FeedForwardLayer):
+    """2D convolution, NHWC (reference nn/conf/layers/ConvolutionLayer.java).
+
+    n_in = input channels, n_out = output channels.
+    """
+
+    kernel_size: Tuple[int, int] = (5, 5)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"  # truncate | same
+    dilation: Tuple[int, int] = (1, 1)
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.dilation = _pair(self.dilation)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            if not isinstance(input_type, ConvolutionalInputType):
+                raise ValueError(f"ConvolutionLayer expects convolutional input, got {input_type}")
+            self.n_in = input_type.channels
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if not isinstance(input_type, ConvolutionalInputType):
+            raise ValueError(f"ConvolutionLayer expects convolutional input, got {input_type}")
+        h, w = _conv_out_hw(input_type.height, input_type.width, self.kernel_size,
+                            self.stride, self.padding, self.convolution_mode, self.dilation)
+        return InputType.convolutional(h, w, self.n_out)
+
+
+@register
+@dataclass
+class SubsamplingLayer(Layer):
+    """Pooling layer (reference nn/conf/layers/SubsamplingLayer.java)."""
+
+    pooling_type: str = "max"  # max | avg | sum | pnorm
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if not isinstance(input_type, ConvolutionalInputType):
+            raise ValueError(f"SubsamplingLayer expects convolutional input, got {input_type}")
+        h, w = _conv_out_hw(input_type.height, input_type.width, self.kernel_size,
+                            self.stride, self.padding, self.convolution_mode, (1, 1))
+        return InputType.convolutional(h, w, input_type.channels)
+
+
+@register
+@dataclass
+class BatchNormalization(FeedForwardLayer):
+    """Batch norm over the feature axis (reference nn/conf/layers/BatchNormalization.java).
+
+    Works on [B, F] and NHWC [B, H, W, C] inputs (per-channel statistics).
+    """
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma: float = 1.0
+    beta: float = 0.0
+    lock_gamma_beta: bool = False
+    use_global_stats: bool = False  # inference-style stats during training
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            if isinstance(input_type, ConvolutionalInputType):
+                self.n_in = input_type.channels
+            else:
+                self.n_in = input_type.flat_size()
+        if self.n_out is None:
+            self.n_out = self.n_in
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+
+@register
+@dataclass
+class LocalResponseNormalization(Layer):
+    """LRN across channels (reference nn/conf/layers/LocalResponseNormalization.java)."""
+
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+
+@dataclass
+class BaseRecurrentLayer(FeedForwardLayer):
+    def get_output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps if isinstance(input_type, RecurrentInputType) else None
+        return InputType.recurrent(self.n_out, ts)
+
+
+@register
+@dataclass
+class GravesLSTM(BaseRecurrentLayer):
+    """LSTM with peephole connections, per Graves (2013) — the reference's
+    flagship RNN (nn/conf/layers/GravesLSTM.java; impl LSTMHelpers.java)."""
+
+    forget_gate_bias_init: float = 1.0
+
+
+@register
+@dataclass
+class LSTM(BaseRecurrentLayer):
+    """Standard (non-peephole) LSTM."""
+
+    forget_gate_bias_init: float = 1.0
+
+
+@register
+@dataclass
+class GravesBidirectionalLSTM(BaseRecurrentLayer):
+    """Bidirectional Graves LSTM (reference GravesBidirectionalLSTM.java)."""
+
+    forget_gate_bias_init: float = 1.0
+
+
+@register
+@dataclass
+class GRU(BaseRecurrentLayer):
+    """Gated recurrent unit (reference nn/conf/layers/GRU.java)."""
+
+
+@register
+@dataclass
+class EmbeddingLayer(FeedForwardLayer):
+    """Index -> dense vector lookup (reference nn/conf/layers/EmbeddingLayer.java).
+    Input: [batch] or [batch, 1] integer indices (or one-hot [batch, n_in])."""
+
+    has_bias: bool = True
+
+
+@register
+@dataclass
+class ActivationLayer(Layer):
+    """Parameterless activation (reference nn/conf/layers/ActivationLayer.java)."""
+
+
+@register
+@dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout layer."""
+
+
+@register
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """Pool over time (RNN) or space (CNN): max|avg|sum|pnorm."""
+
+    pooling_type: str = "max"
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, RecurrentInputType):
+            return InputType.feed_forward(input_type.size)
+        if isinstance(input_type, ConvolutionalInputType):
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+
+@dataclass
+class BasePretrainNetwork(FeedForwardLayer):
+    loss: str = "reconstruction_crossentropy"
+
+    def is_pretrain_layer(self) -> bool:
+        return True
+
+
+@register
+@dataclass
+class RBM(BasePretrainNetwork):
+    """Restricted Boltzmann machine trained with CD-k
+    (reference nn/conf/layers/RBM.java; impl nn/layers/feedforward/rbm/RBM.java:101
+    `contrastiveDivergence`)."""
+
+    hidden_unit: str = "binary"  # binary | gaussian | rectified | softmax
+    visible_unit: str = "binary"  # binary | gaussian | linear | softmax
+    k: int = 1
+    sparsity: float = 0.0
+
+
+@register
+@dataclass
+class AutoEncoder(BasePretrainNetwork):
+    """Denoising autoencoder (reference nn/conf/layers/AutoEncoder.java)."""
+
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+
+
+def _conv_out_hw(h: int, w: int, kernel, stride, padding, mode: str, dilation) -> Tuple[int, int]:
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    ekh = (kh - 1) * dh + 1
+    ekw = (kw - 1) * dw + 1
+    if mode == "same":
+        return ((h + sh - 1) // sh, (w + sw - 1) // sw)
+    oh = (h + 2 * ph - ekh) // sh + 1
+    ow = (w + 2 * pw - ekw) // sw + 1
+    if oh <= 0 or ow <= 0:
+        raise ValueError(f"Invalid conv geometry: input {h}x{w}, kernel {kernel}, "
+                         f"stride {stride}, padding {padding}")
+    return (oh, ow)

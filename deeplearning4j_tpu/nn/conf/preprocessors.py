@@ -1,0 +1,153 @@
+"""Input preprocessors: shape adapters between layer kinds.
+
+Parity with the reference's `nn/conf/preprocessor/*` (13 adapters:
+CnnToFeedForward, FeedForwardToCnn, FeedForwardToRnn, RnnToFeedForward,
+CnnToRnn, RnnToCnn, ...). TPU-first simplification: JAX autodiff derives the
+backward pass automatically, so each preprocessor only defines the pure
+forward `preprocess`. Layouts are NHWC / [B, T, F] (see inputs.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .serde import register
+
+Array = jax.Array
+
+
+@dataclass
+class InputPreProcessor:
+    def preprocess(self, x: Array) -> Array:
+        raise NotImplementedError
+
+
+@register
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B, H, W, C] -> [B, H*W*C] (reference CnnToFeedForwardPreProcessor)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x: Array) -> Array:
+        return x.reshape(x.shape[0], -1)
+
+
+@register
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[B, H*W*C] -> [B, H, W, C] (reference FeedForwardToCnnPreProcessor)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def preprocess(self, x: Array) -> Array:
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+
+@register
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[B*T, F] -> [B, T, F] (reference FeedForwardToRnnPreProcessor).
+
+    `timesteps` must be known; the MultiLayerNetwork runtime passes the
+    current minibatch's T via preprocess_with_time.
+    """
+
+    def preprocess(self, x: Array) -> Array:
+        raise RuntimeError("FeedForwardToRnn requires timesteps; runtime uses preprocess_with_time")
+
+    def preprocess_with_time(self, x: Array, timesteps: int) -> Array:
+        b = x.shape[0] // timesteps
+        return x.reshape(b, timesteps, x.shape[-1])
+
+
+@register
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B, T, F] -> [B*T, F] (reference RnnToFeedForwardPreProcessor)."""
+
+    def preprocess(self, x: Array) -> Array:
+        return x.reshape(-1, x.shape[-1])
+
+
+@register
+@dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[B*T, H, W, C] -> [B, T, H*W*C]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x: Array) -> Array:
+        raise RuntimeError("CnnToRnn requires timesteps; runtime uses preprocess_with_time")
+
+    def preprocess_with_time(self, x: Array, timesteps: int) -> Array:
+        bt = x.shape[0]
+        b = bt // timesteps
+        return x.reshape(b, timesteps, -1)
+
+
+@register
+@dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[B, T, H*W*C] -> [B*T, H, W, C]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def preprocess(self, x: Array) -> Array:
+        b, t = x.shape[0], x.shape[1]
+        return x.reshape(b * t, self.height, self.width, self.channels)
+
+
+@register
+@dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Chain of preprocessors (reference ComposableInputPreProcessor)."""
+
+    processors: Optional[list] = None
+
+    def preprocess(self, x: Array) -> Array:
+        for p in self.processors or []:
+            x = p.preprocess(x)
+        return x
+
+
+@register
+@dataclass
+class UnitVarianceProcessor(InputPreProcessor):
+    """Normalize each example to unit variance (reference UnitVarianceProcessor)."""
+
+    def preprocess(self, x: Array) -> Array:
+        std = jnp.std(x, axis=tuple(range(1, x.ndim)), keepdims=True)
+        return x / jnp.maximum(std, 1e-8)
+
+
+@register
+@dataclass
+class ZeroMeanPrePreProcessor(InputPreProcessor):
+    """Subtract per-example mean (reference ZeroMeanPrePreProcessor)."""
+
+    def preprocess(self, x: Array) -> Array:
+        return x - jnp.mean(x, axis=tuple(range(1, x.ndim)), keepdims=True)
+
+
+@register
+@dataclass
+class BinomialSamplingPreProcessor(InputPreProcessor):
+    """Treat activations as Bernoulli probabilities and keep them clipped to
+    [0,1] (deterministic variant of the reference BinomialSamplingPreProcessor)."""
+
+    def preprocess(self, x: Array) -> Array:
+        return jnp.clip(x, 0.0, 1.0)

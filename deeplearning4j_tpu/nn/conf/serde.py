@@ -1,0 +1,78 @@
+"""Polymorphic config serialization: configs are *data*.
+
+Capability parity with the reference's Jackson JSON/YAML round-trip
+(NeuralNetConfiguration.java:250-270 `toJson`/`fromJson`, `:219-237` YAML) —
+the property that makes configs shippable to workers and storable in
+checkpoints (SURVEY.md §5 'Config / flag system').
+
+Any registered dataclass serializes to a dict with an ``@class`` discriminator,
+recursively. JSON and YAML entry points provided.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+_REGISTRY: Dict[str, Type] = {}
+
+
+def register(cls):
+    """Class decorator: make a dataclass JSON/YAML round-trippable."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def registry() -> Dict[str, Type]:
+    return dict(_REGISTRY)
+
+
+def to_dict(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        d: Dict[str, Any] = {"@class": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            d[f.name] = to_dict(getattr(obj, f.name))
+        return d
+    if isinstance(obj, tuple):
+        return [to_dict(o) for o in obj]
+    if isinstance(obj, list):
+        return [to_dict(o) for o in obj]
+    if isinstance(obj, dict):
+        return {str(k): to_dict(v) for k, v in obj.items()}
+    return obj
+
+
+def from_dict(d: Any) -> Any:
+    if isinstance(d, dict) and "@class" in d:
+        name = d["@class"]
+        if name not in _REGISTRY:
+            raise ValueError(f"Unknown config class '{name}' (not registered)")
+        cls = _REGISTRY[name]
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: from_dict(v) for k, v in d.items() if k != "@class" and k in field_names}
+        return cls(**kwargs)
+    if isinstance(d, list):
+        return [from_dict(x) for x in d]
+    if isinstance(d, dict):
+        return {k: from_dict(v) for k, v in d.items()}
+    return d
+
+
+def to_json(obj: Any, indent: int = 2) -> str:
+    return json.dumps(to_dict(obj), indent=indent, sort_keys=True)
+
+
+def from_json(s: str) -> Any:
+    return from_dict(json.loads(s))
+
+
+def to_yaml(obj: Any) -> str:
+    import yaml
+
+    return yaml.safe_dump(to_dict(obj), sort_keys=True)
+
+
+def from_yaml(s: str) -> Any:
+    import yaml
+
+    return from_dict(yaml.safe_load(s))

@@ -1,0 +1,107 @@
+"""Layer implementation SPI + registry.
+
+Parity with the reference's `nn/api/Layer` + `nn/layers/BaseLayer`
+(deeplearning4j-core/.../nn/api/Layer.java:37 — activate/preOutput/
+backpropGradient — and BaseLayer.java: dropout :59,230, masking :154,361).
+
+TPU-first redesign: a layer impl is a thin stateless object bound to its
+config; params live in an external pytree (dict name->Array), forward is a
+pure jax-traceable function, and the backward pass is derived by jax.grad —
+there is no handwritten `backpropGradient` (the reference needs one because
+ND4J has no autodiff). Non-trainable state (BN running stats) rides in
+`variables`; recurrent stepping state (rnnTimeStep) in `state`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+Params = Dict[str, Array]
+Variables = Dict[str, Array]
+
+LAYER_IMPLS: Dict[str, Type["LayerImpl"]] = {}
+
+
+def register_impl(conf_cls_name: str):
+    def deco(cls):
+        LAYER_IMPLS[conf_cls_name] = cls
+        return cls
+    return deco
+
+
+def impl_for(conf) -> "LayerImpl":
+    name = type(conf).__name__
+    if name not in LAYER_IMPLS:
+        raise ValueError(f"No layer implementation registered for config {name}")
+    return LAYER_IMPLS[name](conf)
+
+
+class LayerImpl:
+    """Stateless functional layer bound to a resolved config."""
+
+    # weight param names regularized by l1/l2 (biases excluded, matching the
+    # reference's weight-only regularization in BaseLayer.calcL2)
+    WEIGHT_KEYS = ("W",)
+
+    def __init__(self, conf):
+        self.conf = conf
+
+    # -- params ----------------------------------------------------------------
+    def init_params(self, key: jax.Array, dtype=jnp.float32) -> Params:
+        return {}
+
+    def init_variables(self, dtype=jnp.float32) -> Variables:
+        return {}
+
+    def has_params(self) -> bool:
+        return True
+
+    # -- forward ---------------------------------------------------------------
+    def forward(
+        self,
+        params: Params,
+        x: Array,
+        *,
+        train: bool = False,
+        rng: Optional[jax.Array] = None,
+        variables: Optional[Variables] = None,
+        mask: Optional[Array] = None,
+    ) -> Tuple[Array, Variables]:
+        """Returns (activations, updated variables)."""
+        raise NotImplementedError
+
+    # -- regularization contribution to the score ------------------------------
+    def reg_loss(self, params: Params) -> Array:
+        l1 = float(getattr(self.conf, "l1", 0.0) or 0.0)
+        l2 = float(getattr(self.conf, "l2", 0.0) or 0.0)
+        total = jnp.asarray(0.0, jnp.float32)
+        if l1 == 0.0 and l2 == 0.0:
+            return total
+        for k in self.WEIGHT_KEYS:
+            if k in params:
+                w = params[k].astype(jnp.float32)
+                if l2:
+                    total = total + 0.5 * l2 * jnp.sum(w * w)
+                if l1:
+                    total = total + l1 * jnp.sum(jnp.abs(w))
+        return total
+
+    # -- helpers ---------------------------------------------------------------
+    def _dropout(self, x: Array, train: bool, rng: Optional[jax.Array]) -> Array:
+        """Input dropout (reference BaseLayer.applyDropOutIfNecessary:59).
+        Inverted dropout: scale kept units by 1/(1-p) at train time."""
+        p = float(getattr(self.conf, "dropout", 0.0) or 0.0)
+        if not train or p <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError("dropout requires an rng key at train time")
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    def activation_fn(self):
+        from ...ops import activations
+        return activations.get(self.conf.activation or "identity")

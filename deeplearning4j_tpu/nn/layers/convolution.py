@@ -1,0 +1,71 @@
+"""Convolution + subsampling (pooling) layer impls, NHWC.
+
+Parity: reference nn/layers/convolution/ConvolutionLayer.java (im2col path +
+cuDNN helper hook at :64,212) and SubsamplingLayer.java (max/avg pooling).
+
+TPU-first: the im2col+gemm formulation and the cuDNN helper seam both
+collapse into `jax.lax.conv_general_dilated`, which XLA tiles directly onto
+the MXU; pooling is `lax.reduce_window`. The accelerated-helper plugin seam
+(SURVEY.md §2.3) is preserved at the op level in ops/helpers.py: layers call
+through a registry that Pallas kernels can override.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import LayerImpl, register_impl
+from .. import weights as winit
+from ...ops import helpers as ophelpers
+
+Array = jax.Array
+
+
+def _padding_config(conf):
+    if conf.convolution_mode == "same":
+        return "SAME"
+    ph, pw = conf.padding
+    return ((ph, ph), (pw, pw))
+
+
+@register_impl("ConvolutionLayer")
+class ConvolutionLayerImpl(LayerImpl):
+    def init_params(self, key, dtype=jnp.float32):
+        conf = self.conf
+        kh, kw = conf.kernel_size
+        dist = conf.dist.spec() if getattr(conf, "dist", None) is not None else None
+        W = winit.init_weights(key, (kh, kw, conf.n_in, conf.n_out),
+                               conf.weight_init or "xavier", dist, dtype)
+        b = jnp.full((conf.n_out,), float(conf.bias_init or 0.0), dtype)
+        return {"W": W, "b": b}
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        x = self._dropout(x, train, rng)
+        conf = self.conf
+        y = ophelpers.conv2d(
+            x, params["W"],
+            stride=conf.stride,
+            padding=_padding_config(conf),
+            dilation=conf.dilation,
+        )
+        y = y + params["b"]
+        return self.activation_fn()(y), variables or {}
+
+
+@register_impl("SubsamplingLayer")
+class SubsamplingLayerImpl(LayerImpl):
+    def has_params(self):
+        return False
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        conf = self.conf
+        y = ophelpers.pool2d(
+            x,
+            kind=conf.pooling_type,
+            kernel=conf.kernel_size,
+            stride=conf.stride,
+            padding=_padding_config(conf),
+            pnorm=getattr(conf, "pnorm", 2),
+        )
+        return y, variables or {}

@@ -1,0 +1,70 @@
+"""BatchNormalization + LocalResponseNormalization impls.
+
+Parity: reference nn/layers/normalization/BatchNormalization.java (train vs
+global stats preOutput:200, gamma/beta :103,227-231) and
+LocalResponseNormalization.java; accelerated via the helper seam
+(reference CudnnBatchNormalizationHelper / CudnnLocalResponseNormalizationHelper).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LayerImpl, register_impl
+from ...ops import helpers as ophelpers
+
+Array = jax.Array
+
+
+@register_impl("BatchNormalization")
+class BatchNormalizationImpl(LayerImpl):
+    WEIGHT_KEYS = ()  # gamma/beta not regularized (matches reference)
+
+    def init_params(self, key, dtype=jnp.float32):
+        conf = self.conf
+        n = conf.n_out
+        if conf.lock_gamma_beta:
+            return {}
+        return {
+            "gamma": jnp.full((n,), float(conf.gamma), dtype),
+            "beta": jnp.full((n,), float(conf.beta), dtype),
+        }
+
+    def init_variables(self, dtype=jnp.float32):
+        n = self.conf.n_out
+        return {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        conf = self.conf
+        variables = variables or self.init_variables(x.dtype)
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        if conf.lock_gamma_beta:
+            gamma = jnp.asarray(conf.gamma, x.dtype)
+            beta = jnp.asarray(conf.beta, x.dtype)
+        else:
+            gamma, beta = params["gamma"], params["beta"]
+
+        if train and not conf.use_global_stats:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            d = jnp.asarray(conf.decay, variables["mean"].dtype)
+            new_vars = {
+                "mean": d * variables["mean"] + (1.0 - d) * mean,
+                "var": d * variables["var"] + (1.0 - d) * var,
+            }
+        else:
+            mean, var = variables["mean"], variables["var"]
+            new_vars = variables
+
+        y = ophelpers.batch_norm(x, gamma, beta, mean, var, eps=conf.eps)
+        return self.activation_fn()(y) if conf.activation not in (None, "identity", "linear") else y, new_vars
+
+
+@register_impl("LocalResponseNormalization")
+class LocalResponseNormalizationImpl(LayerImpl):
+    def has_params(self):
+        return False
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        c = self.conf
+        return ophelpers.lrn(x, k=c.k, n=c.n, alpha=c.alpha, beta=c.beta), variables or {}

@@ -1,0 +1,131 @@
+"""Unsupervised pretrain layers: RBM (CD-k) and denoising AutoEncoder.
+
+Parity: reference nn/layers/feedforward/rbm/RBM.java (contrastiveDivergence
+:101, sampleHiddenGivenVisible :225, Gibbs chain) and
+nn/layers/feedforward/autoencoder/AutoEncoder.java.
+
+As supervised layers they act like a Dense layer (propup). For layerwise
+pretraining (reference MultiLayerNetwork.pretrain:165) they expose:
+  - RBM.cd_gradient:       CD-k gradient (positive - negative phase stats)
+    computed directly — CD is not a differentiable loss, same as reference;
+  - AutoEncoder.pretrain_loss: reconstruction loss, differentiated by jax.grad.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import LayerImpl, register_impl
+from .feedforward import _LinearLayer
+from .. import weights as winit
+from ...ops import losses as losses_mod
+
+Array = jax.Array
+
+
+class _PretrainCore(_LinearLayer):
+    def init_params(self, key, dtype=jnp.float32):
+        params = super().init_params(key, dtype)
+        params["vb"] = jnp.zeros((self.conf.n_in,), dtype)  # visible bias
+        return params
+
+
+@register_impl("RBM")
+class RBMImpl(_PretrainCore):
+    def _hidden_activation(self, pre: Array, rng=None, sample: bool = False) -> Array:
+        kind = self.conf.hidden_unit.lower()
+        if kind == "binary":
+            p = jax.nn.sigmoid(pre)
+            if sample and rng is not None:
+                return jax.random.bernoulli(rng, p).astype(pre.dtype)
+            return p
+        if kind == "rectified":
+            if sample and rng is not None:
+                noise = jax.random.normal(rng, pre.shape, pre.dtype) * jnp.sqrt(
+                    jax.nn.sigmoid(pre))
+                return jnp.maximum(0.0, pre + noise)
+            return jnp.maximum(0.0, pre)
+        if kind == "gaussian":
+            if sample and rng is not None:
+                return pre + jax.random.normal(rng, pre.shape, pre.dtype)
+            return pre
+        if kind == "softmax":
+            return jax.nn.softmax(pre, axis=-1)
+        raise ValueError(f"Unknown hidden unit '{kind}'")
+
+    def _visible_activation(self, pre: Array, rng=None, sample: bool = False) -> Array:
+        kind = self.conf.visible_unit.lower()
+        if kind == "binary":
+            p = jax.nn.sigmoid(pre)
+            if sample and rng is not None:
+                return jax.random.bernoulli(rng, p).astype(pre.dtype)
+            return p
+        if kind in ("gaussian", "linear"):
+            if sample and rng is not None and kind == "gaussian":
+                return pre + jax.random.normal(rng, pre.shape, pre.dtype)
+            return pre
+        if kind == "softmax":
+            return jax.nn.softmax(pre, axis=-1)
+        raise ValueError(f"Unknown visible unit '{kind}'")
+
+    def prop_up(self, params, v: Array, rng=None, sample=False) -> Array:
+        return self._hidden_activation(v @ params["W"] + params["b"], rng, sample)
+
+    def prop_down(self, params, h: Array, rng=None, sample=False) -> Array:
+        return self._visible_activation(h @ params["W"].T + params["vb"], rng, sample)
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        x = self._dropout(x, train, rng)
+        return self.prop_up(params, x), variables or {}
+
+    def cd_gradient(self, params, v0: Array, rng: jax.Array,
+                    k: int = None) -> Tuple[Dict[str, Array], Array]:
+        """CD-k gradients (to MINIMIZE, i.e. negative log-likelihood direction)
+        and reconstruction error. Mirrors RBM.contrastiveDivergence:101."""
+        k = k or int(self.conf.k)
+        B = v0.shape[0]
+        h0_prob = self.prop_up(params, v0)
+        keys = jax.random.split(rng, 2 * k + 1)
+        h = jax.random.bernoulli(keys[0], h0_prob).astype(v0.dtype) \
+            if self.conf.hidden_unit == "binary" else h0_prob
+        vk = v0
+        for i in range(k):
+            vk = self.prop_down(params, h, keys[2 * i + 1],
+                                sample=self.conf.visible_unit == "binary")
+            hk_prob = self.prop_up(params, vk)
+            h = jax.random.bernoulli(keys[2 * i + 2], hk_prob).astype(v0.dtype) \
+                if self.conf.hidden_unit == "binary" else hk_prob
+        hk_prob = self.prop_up(params, vk)
+        # positive - negative phase, averaged over batch; negate for descent
+        gW = -(v0.T @ h0_prob - vk.T @ hk_prob) / B
+        gb = -jnp.mean(h0_prob - hk_prob, axis=0)
+        gvb = -jnp.mean(v0 - vk, axis=0)
+        recon = losses_mod.mse(v0, self.prop_down(params, h0_prob))
+        return {"W": gW, "b": gb, "vb": gvb}, recon
+
+
+@register_impl("AutoEncoder")
+class AutoEncoderImpl(_PretrainCore):
+    def encode(self, params, x: Array) -> Array:
+        return self.activation_fn()(x @ params["W"] + params["b"])
+
+    def decode(self, params, h: Array) -> Array:
+        return self.activation_fn()(h @ params["W"].T + params["vb"])
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        x = self._dropout(x, train, rng)
+        return self.encode(params, x), variables or {}
+
+    def pretrain_loss(self, params, x: Array, rng: jax.Array) -> Array:
+        """Denoising reconstruction loss (corruption = input dropout noise)."""
+        level = float(self.conf.corruption_level or 0.0)
+        if level > 0.0:
+            keep = jax.random.bernoulli(rng, 1.0 - level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        else:
+            corrupted = x
+        recon = self.decode(params, self.encode(params, corrupted))
+        loss_fn = losses_mod.get(self.conf.loss or "reconstruction_crossentropy")
+        return loss_fn(x, recon)

@@ -1,0 +1,494 @@
+"""MultiLayerNetwork: the sequential-network facade.
+
+Capability parity with the reference's
+deeplearning4j-core/.../nn/multilayer/MultiLayerNetwork.java (2,369 LoC):
+fit(:1013 — async wrap, pretrain branch, TBPTT branch), feedForward(:619),
+backprop(:1067), doTruncatedBPTT(:1159), output(:1502), rnnTimeStep (stateful
+inference), score, flat param views, layerwise pretrain(:165)/finetune(:1331).
+
+TPU-first redesign (SURVEY.md §7): the Solver/Updater/StepFunction object
+machinery collapses into ONE jit-compiled pure `train_step`:
+    (params, variables, updater_state, step, rng, batch) -> (params', ...)
+traced once per input shape and fused end-to-end by XLA — forward, backward
+(jax.grad — no handwritten backpropGradient), gradient normalization, lr
+schedule, updater kernel, and parameter update all in a single HBM-resident
+program. Listeners observe from the host side between steps, like the
+reference's IterationListener hook.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .conf.config import (BACKPROP_TBPTT, MultiLayerConfiguration,
+                          NeuralNetConfiguration)
+from .conf.preprocessors import (CnnToRnnPreProcessor,
+                                 FeedForwardToRnnPreProcessor)
+from .layers.base import LayerImpl, impl_for
+from .layers.pretrain import AutoEncoderImpl, RBMImpl
+from .layers.recurrent import BaseRecurrentImpl
+from .updater.gradnorm import apply_gradient_normalization
+from .updater.schedules import effective_lr
+from ..ops import losses as losses_mod
+
+Array = jax.Array
+
+
+def _dtype_of(conf: NeuralNetConfiguration):
+    return {"bfloat16": jnp.bfloat16, "float64": jnp.float64}.get(conf.dtype, jnp.float32)
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self._impls: List[LayerImpl] = [impl_for(l) for l in conf.layers]
+        self.params: List[Dict[str, Array]] = []
+        self.variables: List[Dict[str, Array]] = []
+        self.updater_state: List[Dict[str, Dict[str, Array]]] = []
+        self.step = 0
+        self.score_ = float("nan")
+        self.listeners: List[Any] = []
+        self._rnn_state: Dict[int, Dict[str, Array]] = {}
+        self._jit_cache: Dict[Any, Any] = {}
+        self._key = jax.random.PRNGKey(conf.conf.seed)
+        self._initialized = False
+
+    # ------------------------------------------------------------------ init --
+    def init(self) -> "MultiLayerNetwork":
+        dtype = _dtype_of(self.conf.conf)
+        key = jax.random.PRNGKey(self.conf.conf.seed)
+        keys = jax.random.split(key, max(len(self._impls), 1))
+        self.params = [impl.init_params(keys[i], dtype)
+                       for i, impl in enumerate(self._impls)]
+        self.variables = [impl.init_variables(dtype) for impl in self._impls]
+        self.updater_state = [
+            {name: self.conf.layers[i].updater.init_state(p)
+             for name, p in layer_params.items()}
+            for i, layer_params in enumerate(self.params)
+        ]
+        self.step = 0
+        self._initialized = True
+        return self
+
+    def _check_init(self):
+        if not self._initialized:
+            self.init()
+
+    # ------------------------------------------------------------- forward ---
+    def _forward_impl(self, params, variables, x, *, train, rng, fmask=None,
+                      states=None, upto: Optional[int] = None):
+        """Pure forward through layers [0, upto). Returns
+        (activations per layer, new variables, new rnn states)."""
+        conf = self.conf
+        n = len(self._impls) if upto is None else upto
+        timesteps = x.shape[1] if x.ndim == 3 else 1
+        if rng is None:
+            rngs = [None] * n
+        else:
+            rngs = list(jax.random.split(rng, max(n, 1)))
+        acts = []
+        new_vars = list(variables)
+        new_states: Dict[int, Any] = {}
+        cur = x
+        for i in range(n):
+            proc = conf.preprocessor(i)
+            if proc is not None:
+                if isinstance(proc, (FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor)):
+                    cur = proc.preprocess_with_time(cur, timesteps)
+                else:
+                    cur = proc.preprocess(cur)
+            if cur.ndim == 3:
+                timesteps = cur.shape[1]
+            impl = self._impls[i]
+            lmask_arg = fmask if cur.ndim == 3 else None
+            if isinstance(impl, BaseRecurrentImpl):
+                state0 = (states or {}).get(i)
+                y, st = impl.forward_with_state(params[i], cur, state0, train=train,
+                                                rng=rngs[i], mask=lmask_arg)
+                new_states[i] = st
+            else:
+                y, nv = impl.forward(params[i], cur, train=train, rng=rngs[i],
+                                     variables=variables[i], mask=lmask_arg)
+                new_vars[i] = nv
+            acts.append(y)
+            cur = y
+        return acts, new_vars, new_states
+
+    def _loss_from_output(self, out: Array, y: Array, lmask: Optional[Array]):
+        out_layer_conf = self.conf.layers[-1]
+        loss_name = getattr(out_layer_conf, "loss", None) or "mse"
+        loss_fn = losses_mod.get(loss_name)
+        if out.ndim == 3:  # RNN output: flatten time
+            o = out.reshape(-1, out.shape[-1])
+            t = y.reshape(-1, y.shape[-1])
+            m = lmask.reshape(-1) if lmask is not None else None
+            return loss_fn(t, o, m)
+        m = lmask.reshape(-1) if lmask is not None else None
+        return loss_fn(y, out, m)
+
+    def _reg_loss(self, params):
+        total = jnp.asarray(0.0, jnp.float32)
+        for impl, p in zip(self._impls, params):
+            total = total + impl.reg_loss(p)
+        return total
+
+    # ---------------------------------------------------------- train step ---
+    def _apply_updaters(self, params, grads, ustates, step):
+        gconf = self.conf.conf
+        new_params, new_ustates = [], []
+        for i, layer_conf in enumerate(self.conf.layers):
+            lgrads = grads[i]
+            if not lgrads:
+                new_params.append(params[i])
+                new_ustates.append(ustates[i])
+                continue
+            lgrads = apply_gradient_normalization(
+                lgrads, layer_conf.gradient_normalization or "none",
+                layer_conf.gradient_normalization_threshold or 1.0)
+            updater = layer_conf.updater
+            base_lr = updater_lr = getattr(updater, "learning_rate", -1.0)
+            if updater_lr is None or updater_lr < 0:
+                base_lr = layer_conf.learning_rate
+            bias_lr = layer_conf.bias_learning_rate or base_lr
+            lp, lu = {}, {}
+            for name, g in lgrads.items():
+                lr0 = bias_lr if name in ("b", "vb", "beta") else base_lr
+                lr = effective_lr(lr0, step, gconf.lr_policy,
+                                  gconf.lr_policy_decay_rate, gconf.lr_policy_power,
+                                  gconf.lr_policy_steps, gconf.max_num_iterations,
+                                  gconf.lr_schedule).astype(g.dtype)
+                delta, new_state = updater.apply(ustates[i][name], g, lr, step)
+                lp[name] = params[i][name] + delta
+                lu[name] = new_state
+            new_params.append(lp)
+            new_ustates.append(lu)
+        return new_params, new_ustates
+
+    def _get_train_step(self, key):
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        has_fmask, has_lmask, carry_state = key
+
+        def loss_fn(params, variables, x, y, fmask, lmask, rng, states):
+            acts, new_vars, new_states = self._forward_impl(
+                params, variables, x, train=True, rng=rng, fmask=fmask,
+                states=states if carry_state else None)
+            out = acts[-1]
+            loss = self._loss_from_output(out, y, lmask) + self._reg_loss(params)
+            return loss.astype(jnp.float32), (new_vars, new_states)
+
+        def train_step(params, variables, ustates, step, rng, x, y, fmask, lmask, states):
+            (loss, (new_vars, new_states)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, variables, x, y, fmask, lmask, rng, states)
+            new_params, new_ustates = self._apply_updaters(params, grads, ustates, step)
+            return new_params, new_vars, new_ustates, loss, new_states
+
+        fn = jax.jit(train_step, donate_argnums=(0, 2))
+        self._jit_cache[key] = fn
+        return fn
+
+    def fit_batch(self, x, y, fmask=None, lmask=None, states=None,
+                  carry_state=False):
+        """One (or conf.iterations) optimization step(s) on a single minibatch."""
+        self._check_init()
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        fmask = jnp.asarray(fmask) if fmask is not None else None
+        lmask = jnp.asarray(lmask) if lmask is not None else None
+        step_fn = self._get_train_step((fmask is not None, lmask is not None, carry_state))
+        out_states = states
+        for _ in range(max(1, self.conf.conf.iterations)):
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.variables, self.updater_state, loss,
+             out_states) = step_fn(self.params, self.variables, self.updater_state,
+                                   jnp.asarray(self.step), sub, x, y, fmask, lmask,
+                                   states if carry_state else None)
+            self.score_ = float(loss)
+            self.step += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.step)
+        return out_states
+
+    # ------------------------------------------------------------------ fit --
+    def fit(self, data, labels=None):
+        """fit(DataSetIterator) | fit(DataSet) | fit(x, y).
+        Mirrors MultiLayerNetwork.fit(DataSetIterator):1013."""
+        self._check_init()
+        if labels is not None:
+            self._fit_one(jnp.asarray(data), jnp.asarray(labels), None, None)
+            return self
+        if hasattr(data, "features"):  # single DataSet
+            self._fit_one(data.features, data.labels,
+                          getattr(data, "features_mask", None),
+                          getattr(data, "labels_mask", None))
+            return self
+        # iterator path
+        if self.conf.pretrain:
+            self.pretrain(data)
+            if hasattr(data, "reset"):
+                data.reset()
+        if self.conf.backprop:
+            for ds in data:
+                self._fit_one(ds.features, ds.labels,
+                              getattr(ds, "features_mask", None),
+                              getattr(ds, "labels_mask", None))
+        return self
+
+    def _fit_one(self, x, y, fmask, lmask):
+        if (self.conf.backprop_type == BACKPROP_TBPTT
+                and jnp.asarray(x).ndim == 3):
+            self._do_truncated_bptt(x, y, fmask, lmask)
+        else:
+            self.fit_batch(x, y, fmask, lmask)
+
+    def _do_truncated_bptt(self, x, y, fmask, lmask):
+        """Sliding-window TBPTT with carried RNN state
+        (reference doTruncatedBPTT:1159 + updateRnnStateWithTBPTTState:1217)."""
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        states = {i: impl.init_state(x.shape[0], x.dtype)
+                  for i, impl in enumerate(self._impls)
+                  if isinstance(impl, BaseRecurrentImpl)}
+        start = 0
+        while start < T:
+            end = min(start + L, T)
+            xs = x[:, start:end]
+            ys = y[:, start:end] if y.ndim == 3 else y
+            fs = fmask[:, start:end] if fmask is not None else None
+            ls = lmask[:, start:end] if lmask is not None else None
+            states = self.fit_batch(xs, ys, fs, ls, states=states, carry_state=True)
+            states = jax.tree_util.tree_map(jax.lax.stop_gradient, states)
+            start = end
+
+    # ------------------------------------------------------------- pretrain --
+    def pretrain(self, iterator):
+        """Greedy layerwise pretraining (reference pretrain:165)."""
+        self._check_init()
+        for i, impl in enumerate(self._impls):
+            if not self.conf.layers[i].is_pretrain_layer():
+                continue
+            step_fn = self._make_pretrain_step(i)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                x = jnp.asarray(ds.features)
+                self._key, k1, k2 = jax.random.split(self._key, 3)
+                # forward input through earlier layers (train-mode activations)
+                if i > 0:
+                    acts, _, _ = self._forward_impl(self.params, self.variables, x,
+                                                    train=False, rng=None, upto=i)
+                    x = acts[-1]
+                self.params[i], self.updater_state[i], loss = step_fn(
+                    self.params[i], self.updater_state[i], jnp.asarray(self.step),
+                    k2, x)
+                self.score_ = float(loss)
+
+    def _make_pretrain_step(self, i: int):
+        impl = self._impls[i]
+        layer_conf = self.conf.layers[i]
+        gconf = self.conf.conf
+
+        def apply_update(params_i, ustate_i, grads, step):
+            grads = apply_gradient_normalization(
+                grads, layer_conf.gradient_normalization or "none",
+                layer_conf.gradient_normalization_threshold or 1.0)
+            updater = layer_conf.updater
+            base_lr = getattr(updater, "learning_rate", -1.0)
+            if base_lr is None or base_lr < 0:
+                base_lr = layer_conf.learning_rate
+            new_p, new_u = {}, {}
+            for name, g in grads.items():
+                lr = effective_lr(base_lr, step, gconf.lr_policy,
+                                  gconf.lr_policy_decay_rate, gconf.lr_policy_power,
+                                  gconf.lr_policy_steps, gconf.max_num_iterations,
+                                  gconf.lr_schedule).astype(g.dtype)
+                delta, ns = updater.apply(ustate_i[name], g, lr, step)
+                new_p[name] = params_i[name] + delta
+                new_u[name] = ns
+            return new_p, new_u
+
+        if isinstance(impl, RBMImpl):
+            def rbm_step(params_i, ustate_i, step, rng, x):
+                grads, recon = impl.cd_gradient(params_i, x, rng)
+                new_p, new_u = apply_update(params_i, ustate_i, grads, step)
+                return new_p, new_u, recon
+            return jax.jit(rbm_step)
+
+        if isinstance(impl, AutoEncoderImpl):
+            def ae_step(params_i, ustate_i, step, rng, x):
+                loss, grads = jax.value_and_grad(impl.pretrain_loss)(params_i, x, rng)
+                new_p, new_u = apply_update(params_i, ustate_i, grads, step)
+                return new_p, new_u, loss
+            return jax.jit(ae_step)
+
+        raise ValueError(f"Layer {i} is not a pretrainable layer")
+
+    def finetune(self, iterator):
+        """Supervised pass after pretraining (reference finetune:1331)."""
+        for ds in iterator:
+            self._fit_one(ds.features, ds.labels, None, None)
+
+    # ---------------------------------------------------------- inference ----
+    def _get_forward(self, train: bool):
+        key = ("fwd", train)
+        if key not in self._jit_cache:
+            def fwd(params, variables, x, fmask):
+                acts, _, _ = self._forward_impl(params, variables, x, train=False,
+                                                rng=None, fmask=fmask)
+                return acts[-1]
+            self._jit_cache[key] = jax.jit(fwd)
+        return self._jit_cache[key]
+
+    def output(self, x, train: bool = False, fmask=None) -> Array:
+        """Network output (reference output:1502)."""
+        self._check_init()
+        return self._get_forward(train)(self.params, self.variables, jnp.asarray(x),
+                                        jnp.asarray(fmask) if fmask is not None else None)
+
+    def predict(self, x) -> np.ndarray:
+        out = self.output(x)
+        return np.asarray(jnp.argmax(out, axis=-1))
+
+    def feed_forward(self, x, train: bool = False) -> List[Array]:
+        """All layer activations, input first (reference feedForward:619)."""
+        self._check_init()
+        acts, _, _ = self._forward_impl(self.params, self.variables, jnp.asarray(x),
+                                        train=train, rng=None)
+        return [jnp.asarray(x)] + list(acts)
+
+    def score(self, dataset=None, x=None, y=None) -> float:
+        """Loss (incl. regularization) on a dataset, or last-minibatch score."""
+        if dataset is None and x is None:
+            return self.score_
+        if dataset is not None:
+            x, y = dataset.features, dataset.labels
+            lmask = getattr(dataset, "labels_mask", None)
+            fmask = getattr(dataset, "features_mask", None)
+        else:
+            lmask = fmask = None
+        acts, _, _ = self._forward_impl(self.params, self.variables, jnp.asarray(x),
+                                        train=False, rng=None,
+                                        fmask=jnp.asarray(fmask) if fmask is not None else None)
+        loss = self._loss_from_output(acts[-1], jnp.asarray(y),
+                                      jnp.asarray(lmask) if lmask is not None else None)
+        return float(loss + self._reg_loss(self.params))
+
+    # -------------------------------------------------------- rnn stepping ---
+    def rnn_time_step(self, x) -> Array:
+        """Stateful streaming inference (reference rnnTimeStep:1460).
+        x: [B, T, F]; carries hidden state across calls."""
+        self._check_init()
+        x = jnp.asarray(x)
+        if x.ndim == 2:
+            x = x[:, None, :]
+        acts, _, new_states = self._forward_impl(
+            self.params, self.variables, x, train=False, rng=None,
+            states=self._rnn_state or None)
+        self._rnn_state = new_states
+        return acts[-1]
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = {}
+
+    def rnn_get_previous_state(self, layer_idx: int):
+        return self._rnn_state.get(layer_idx)
+
+    def rnn_set_previous_state(self, layer_idx: int, state):
+        self._rnn_state[layer_idx] = state
+
+    # ------------------------------------------------------------ params -----
+    def num_params(self) -> int:
+        return int(sum(int(np.prod(p.shape)) for lp in self.params for p in lp.values()))
+
+    def params_flat(self) -> np.ndarray:
+        """Flat parameter view in deterministic (layer, name) order —
+        parity with the reference's params-as-flat-view contract
+        (nn/api/Model.java:95-108)."""
+        chunks = []
+        for lp in self.params:
+            for name in sorted(lp):
+                chunks.append(np.asarray(lp[name]).reshape(-1))
+        return np.concatenate(chunks) if chunks else np.zeros(0, np.float32)
+
+    def set_params_flat(self, flat: np.ndarray):
+        flat = np.asarray(flat)
+        off = 0
+        new_params = []
+        for lp in self.params:
+            nlp = {}
+            for name in sorted(lp):
+                n = int(np.prod(lp[name].shape))
+                nlp[name] = jnp.asarray(flat[off:off + n].reshape(lp[name].shape),
+                                        lp[name].dtype)
+                off += n
+            new_params.append(nlp)
+        if off != flat.size:
+            raise ValueError(f"Expected {off} params, got {flat.size}")
+        self.params = new_params
+
+    def updater_state_flat(self) -> np.ndarray:
+        chunks = []
+        for lu in self.updater_state:
+            for name in sorted(lu):
+                for sname in sorted(lu[name]):
+                    chunks.append(np.asarray(lu[name][sname]).reshape(-1))
+        return np.concatenate(chunks) if chunks else np.zeros(0, np.float32)
+
+    def set_updater_state_flat(self, flat: np.ndarray):
+        flat = np.asarray(flat)
+        off = 0
+        new_states = []
+        for lu in self.updater_state:
+            nlu = {}
+            for name in sorted(lu):
+                nlu[name] = {}
+                for sname in sorted(lu[name]):
+                    arr = lu[name][sname]
+                    n = int(np.prod(arr.shape))
+                    nlu[name][sname] = jnp.asarray(flat[off:off + n].reshape(arr.shape),
+                                                   arr.dtype)
+                    off += n
+            new_states.append(nlu)
+        self.updater_state = new_states
+
+    # ------------------------------------------------------------- misc ------
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        if self._initialized:
+            net.init()
+            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            net.variables = jax.tree_util.tree_map(lambda a: a, self.variables)
+            net.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+            net.step = self.step
+        return net
+
+    def evaluate(self, iterator):
+        from ..evaluation.evaluation import Evaluation
+        ev = Evaluation()
+        for ds in iterator:
+            out = self.output(ds.features,
+                              fmask=getattr(ds, "features_mask", None))
+            ev.eval(ds.labels, out, mask=getattr(ds, "labels_mask", None))
+        return ev
+
+    def summary(self) -> str:
+        lines = ["=" * 70]
+        for i, lc in enumerate(self.conf.layers):
+            nparams = sum(int(np.prod(p.shape)) for p in self.params[i].values()) \
+                if self._initialized else 0
+            lines.append(f"{i:3d}  {type(lc).__name__:30s} params={nparams}")
+        lines.append(f"Total params: {self.num_params() if self._initialized else '?'}")
+        lines.append("=" * 70)
+        return "\n".join(lines)

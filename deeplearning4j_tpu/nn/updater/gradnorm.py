@@ -1,0 +1,56 @@
+"""Gradient normalization strategies.
+
+Parity with the reference `GradientNormalization` enum applied in
+BaseUpdater.preApply (tested by nn/updater/TestGradientNormalization in the
+reference). Operates on a per-layer dict of param-name -> gradient.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+NONE = "none"
+RENORMALIZE_L2_PER_LAYER = "renormalizel2perlayer"
+RENORMALIZE_L2_PER_PARAM_TYPE = "renormalizel2perparamtype"
+CLIP_ELEMENT_WISE_ABSOLUTE_VALUE = "clipelementwiseabsolutevalue"
+CLIP_L2_PER_LAYER = "clipl2perlayer"
+CLIP_L2_PER_PARAM_TYPE = "clipl2perparamtype"
+
+ALL = (NONE, RENORMALIZE_L2_PER_LAYER, RENORMALIZE_L2_PER_PARAM_TYPE,
+       CLIP_ELEMENT_WISE_ABSOLUTE_VALUE, CLIP_L2_PER_LAYER, CLIP_L2_PER_PARAM_TYPE)
+
+_EPS = 1e-8
+
+
+def _l2(x: Array) -> Array:
+    return jnp.sqrt(jnp.sum(x * x))
+
+
+def apply_gradient_normalization(
+    grads: Dict[str, Array], strategy: str, threshold: float = 1.0
+) -> Dict[str, Array]:
+    s = (strategy or NONE).lower()
+    if s == NONE:
+        return grads
+    if s == RENORMALIZE_L2_PER_LAYER:
+        total = jnp.sqrt(sum(jnp.sum(g * g) for g in grads.values()) + _EPS)
+        return {k: g / total for k, g in grads.items()}
+    if s == RENORMALIZE_L2_PER_PARAM_TYPE:
+        return {k: g / (_l2(g) + _EPS) for k, g in grads.items()}
+    if s == CLIP_ELEMENT_WISE_ABSOLUTE_VALUE:
+        return {k: jnp.clip(g, -threshold, threshold) for k, g in grads.items()}
+    if s == CLIP_L2_PER_LAYER:
+        total = jnp.sqrt(sum(jnp.sum(g * g) for g in grads.values()) + _EPS)
+        scale = jnp.where(total > threshold, threshold / total, 1.0)
+        return {k: g * scale for k, g in grads.items()}
+    if s == CLIP_L2_PER_PARAM_TYPE:
+        out = {}
+        for k, g in grads.items():
+            n = _l2(g) + _EPS
+            out[k] = g * jnp.where(n > threshold, threshold / n, 1.0)
+        return out
+    raise ValueError(f"Unknown gradient normalization '{strategy}'. Available: {ALL}")

@@ -1,0 +1,49 @@
+"""Learning-rate decay policies.
+
+Parity with the reference `LearningRatePolicy` enum + the schedule application
+in BaseUpdater (`applyLrDecayPolicy`, deeplearning4j-core/.../nn/updater/
+BaseUpdater.java:88-120 region). jit-safe: `iteration` may be a traced scalar.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+POLICIES = ("none", "exponential", "inverse", "poly", "sigmoid", "step", "schedule")
+
+
+def effective_lr(
+    base_lr: float,
+    iteration,
+    policy: str = "none",
+    decay_rate: float = 0.0,
+    power: float = 1.0,
+    steps: float = 1.0,
+    max_iterations: int = 1,
+    schedule: Optional[Dict[str, float]] = None,
+):
+    """Compute the scheduled learning rate for `iteration` (0-based)."""
+    it = jnp.asarray(iteration, jnp.float32)
+    lr = jnp.asarray(base_lr, jnp.float32)
+    policy = (policy or "none").lower()
+    if policy == "none":
+        return lr
+    if policy == "exponential":
+        return lr * jnp.power(decay_rate, it)
+    if policy == "inverse":
+        return lr / jnp.power(1.0 + decay_rate * it, power)
+    if policy == "poly":
+        frac = jnp.clip(it / jnp.maximum(float(max_iterations), 1.0), 0.0, 1.0)
+        return lr * jnp.power(1.0 - frac, power)
+    if policy == "sigmoid":
+        return lr / (1.0 + jnp.exp(decay_rate * (it - steps)))
+    if policy == "step":
+        return lr * jnp.power(decay_rate, jnp.floor(it / steps))
+    if policy == "schedule":
+        # piecewise-constant: lr takes the value of the largest key <= iteration
+        out = lr
+        for k, v in sorted((int(k), float(v)) for k, v in (schedule or {}).items()):
+            out = jnp.where(it >= k, v, out)
+        return out
+    raise ValueError(f"Unknown lr policy '{policy}'. Available: {POLICIES}")

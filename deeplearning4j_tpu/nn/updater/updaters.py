@@ -1,0 +1,200 @@
+"""Updater (learning-rule) kernels + their serializable configs.
+
+Capability parity with the reference updater zoo: `nn/updater/*`
+(Sgd/Adam/AdaGrad/AdaDelta/RmsProp/Nesterovs/NoOp wrappers in
+deeplearning4j-core/.../nn/updater/, kernels in ND4J
+`org.nd4j.linalg.learning.GradientUpdater` — SURVEY.md §2.1). TPU-first
+redesign: each updater is a pure (state, grad, lr, step) -> (delta, state)
+function applied over the whole param pytree inside the single jit-compiled
+train step, instead of the per-param-name Java object loop
+(BaseUpdater.java:35). `delta` is ADDED to params.
+
+State shapes mirror param shapes, so updater state averages across
+data-parallel replicas exactly like the reference's UpdaterAggregator
+(nn/updater/aggregate/UpdaterAggregator.java) averages Spark worker state.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.serde import register
+
+Array = jax.Array
+State = Dict[str, Array]
+
+_EPS_DEFAULT = 1e-8
+
+
+@dataclass
+class UpdaterConfig:
+    """Base updater config. learning_rate < 0 means inherit the net-level lr."""
+
+    def init_state(self, param: Array) -> State:
+        return {}
+
+    def apply(self, state: State, grad: Array, lr: Array, step: Array) -> Tuple[Array, State]:
+        raise NotImplementedError
+
+
+@register
+@dataclass
+class Sgd(UpdaterConfig):
+    learning_rate: float = -1.0
+
+    def apply(self, state, grad, lr, step):
+        return -lr * grad, state
+
+
+@register
+@dataclass
+class NoOp(UpdaterConfig):
+    """Gradient applied raw (reference NoOpUpdater)."""
+
+    def apply(self, state, grad, lr, step):
+        return -grad, state
+
+
+@register
+@dataclass
+class Nesterovs(UpdaterConfig):
+    learning_rate: float = -1.0
+    momentum: float = 0.9
+    # iteration -> momentum overrides (reference momentumAfter schedule)
+    momentum_schedule: Dict[str, float] = field(default_factory=dict)
+
+    def init_state(self, param):
+        return {"v": jnp.zeros_like(param)}
+
+    def _momentum(self, step):
+        mu = jnp.asarray(self.momentum, jnp.float32)
+        for it, m in sorted((int(k), v) for k, v in self.momentum_schedule.items()):
+            mu = jnp.where(step >= it, m, mu)
+        return mu
+
+    def apply(self, state, grad, lr, step):
+        mu = self._momentum(step).astype(grad.dtype)
+        v = state["v"]
+        v_new = mu * v - lr * grad
+        # Nesterov look-ahead: params += -mu*v + (1+mu)*v_new
+        delta = (1.0 + mu) * v_new - mu * v
+        return delta, {"v": v_new}
+
+
+@register
+@dataclass
+class Adam(UpdaterConfig):
+    learning_rate: float = -1.0
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = _EPS_DEFAULT
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
+
+    def apply(self, state, grad, lr, step):
+        t = (step + 1).astype(grad.dtype)
+        b1 = jnp.asarray(self.beta1, grad.dtype)
+        b2 = jnp.asarray(self.beta2, grad.dtype)
+        m = b1 * state["m"] + (1.0 - b1) * grad
+        u = b2 * state["u"] + (1.0 - b2) * grad * grad
+        mhat = m / (1.0 - jnp.power(b1, t))
+        uhat = u / (1.0 - jnp.power(b2, t))
+        delta = -lr * mhat / (jnp.sqrt(uhat) + self.epsilon)
+        return delta, {"m": m, "u": u}
+
+
+@register
+@dataclass
+class AdaGrad(UpdaterConfig):
+    learning_rate: float = -1.0
+    epsilon: float = _EPS_DEFAULT
+
+    def init_state(self, param):
+        return {"h": jnp.zeros_like(param)}
+
+    def apply(self, state, grad, lr, step):
+        h = state["h"] + grad * grad
+        delta = -lr * grad / (jnp.sqrt(h) + self.epsilon)
+        return delta, {"h": h}
+
+
+@register
+@dataclass
+class AdaDelta(UpdaterConfig):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_state(self, param):
+        return {"eg": jnp.zeros_like(param), "edx": jnp.zeros_like(param)}
+
+    def apply(self, state, grad, lr, step):
+        rho = jnp.asarray(self.rho, grad.dtype)
+        eg = rho * state["eg"] + (1.0 - rho) * grad * grad
+        dx = -jnp.sqrt(state["edx"] + self.epsilon) / jnp.sqrt(eg + self.epsilon) * grad
+        edx = rho * state["edx"] + (1.0 - rho) * dx * dx
+        return dx, {"eg": eg, "edx": edx}
+
+
+@register
+@dataclass
+class RmsProp(UpdaterConfig):
+    learning_rate: float = -1.0
+    rms_decay: float = 0.95
+    epsilon: float = _EPS_DEFAULT
+
+    def init_state(self, param):
+        return {"eg": jnp.zeros_like(param)}
+
+    def apply(self, state, grad, lr, step):
+        d = jnp.asarray(self.rms_decay, grad.dtype)
+        eg = d * state["eg"] + (1.0 - d) * grad * grad
+        delta = -lr * grad / jnp.sqrt(eg + self.epsilon)
+        return delta, {"eg": eg}
+
+
+@register
+@dataclass
+class AdaMax(UpdaterConfig):
+    learning_rate: float = -1.0
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = _EPS_DEFAULT
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
+
+    def apply(self, state, grad, lr, step):
+        t = (step + 1).astype(grad.dtype)
+        b1 = jnp.asarray(self.beta1, grad.dtype)
+        m = b1 * state["m"] + (1.0 - b1) * grad
+        u = jnp.maximum(self.beta2 * state["u"], jnp.abs(grad))
+        delta = -lr / (1.0 - jnp.power(b1, t)) * m / (u + self.epsilon)
+        return delta, {"m": m, "u": u}
+
+
+UPDATERS = {
+    "sgd": Sgd,
+    "noop": NoOp,
+    "nesterovs": Nesterovs,
+    "adam": Adam,
+    "adagrad": AdaGrad,
+    "adadelta": AdaDelta,
+    "rmsprop": RmsProp,
+    "adamax": AdaMax,
+}
+
+
+def resolve_updater(u) -> UpdaterConfig:
+    """Accept an UpdaterConfig instance or a string name."""
+    if isinstance(u, UpdaterConfig):
+        return u
+    if isinstance(u, str):
+        try:
+            return UPDATERS[u.lower()]()
+        except KeyError:
+            raise ValueError(f"Unknown updater '{u}'. Available: {sorted(UPDATERS)}") from None
+    raise TypeError(f"Cannot resolve updater from {type(u)}")

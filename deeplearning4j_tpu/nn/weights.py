@@ -1,0 +1,119 @@
+"""Weight initialization schemes.
+
+Capability parity with the reference's `nn/weights/WeightInit` enum +
+`WeightInitUtil` (deeplearning4j-core/.../nn/weights/WeightInitUtil.java), which
+draws from ND4J RNG distributions. Here every draw takes an explicit threefry
+key (TPU-first: deterministic, reproducible across device meshes — unlike
+ND4J's global RNG, see SURVEY.md §7 'RNG parity').
+
+Schemes: ZERO, SIZE, UNIFORM, NORMALIZED, VI, XAVIER, RELU, DISTRIBUTION.
+fan_in/fan_out follow the reference convention: for a [n_in, n_out] weight
+matrix fan_in = n_in, fan_out = n_out; for conv kernels [kh, kw, c_in, c_out]
+fan_in = kh*kw*c_in, fan_out = kh*kw*c_out.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+ZERO = "zero"
+ONES = "ones"
+SIZE = "size"
+UNIFORM = "uniform"
+NORMALIZED = "normalized"
+VI = "vi"
+XAVIER = "xavier"
+XAVIER_UNIFORM = "xavier_uniform"
+RELU = "relu"
+RELU_UNIFORM = "relu_uniform"
+LECUN = "lecun"
+DISTRIBUTION = "distribution"
+
+ALL = (ZERO, ONES, SIZE, UNIFORM, NORMALIZED, VI, XAVIER, XAVIER_UNIFORM, RELU,
+       RELU_UNIFORM, LECUN, DISTRIBUTION)
+
+
+def _fans(shape: Sequence[int]) -> Tuple[float, float]:
+    if len(shape) == 1:
+        return float(shape[0]), float(shape[0])
+    if len(shape) == 2:
+        return float(shape[0]), float(shape[1])
+    receptive = 1.0
+    for d in shape[:-2]:
+        receptive *= d
+    return receptive * shape[-2], receptive * shape[-1]
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    scheme: str = XAVIER,
+    distribution: Optional[dict] = None,
+    dtype: jnp.dtype = jnp.float32,
+) -> Array:
+    """Draw a weight tensor. `distribution` is a serialized Distribution config
+    (see nn/conf/distributions.py) used when scheme == DISTRIBUTION."""
+    scheme = scheme.lower()
+    shape = tuple(int(s) for s in shape)
+    fan_in, fan_out = _fans(shape)
+
+    if scheme == ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme == ONES:
+        return jnp.ones(shape, dtype)
+    if scheme == SIZE:
+        # uniform in [-1/sqrt(fan_in+fan_out), 1/sqrt(fan_in+fan_out)]
+        b = 1.0 / jnp.sqrt(fan_in + fan_out)
+        return jax.random.uniform(key, shape, dtype, -b, b)
+    if scheme == UNIFORM:
+        a = 1.0 / jnp.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == NORMALIZED:
+        # reference: uniform shifted by -0.5, scaled by 1/fan_in region
+        u = jax.random.uniform(key, shape, dtype)
+        return (u - 0.5) / fan_in
+    if scheme == VI:
+        # variance-init: uniform scaled by sqrt(6/(fan_in+fan_out)) region
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        u = jax.random.uniform(key, shape, dtype)
+        return u * 2.0 * r - r
+    if scheme == XAVIER:
+        std = jnp.sqrt(2.0 / (fan_in + fan_out))
+        return jax.random.normal(key, shape, dtype) * std
+    if scheme == XAVIER_UNIFORM:
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == RELU:
+        std = jnp.sqrt(2.0 / fan_in)
+        return jax.random.normal(key, shape, dtype) * std
+    if scheme == RELU_UNIFORM:
+        r = jnp.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == LECUN:
+        std = jnp.sqrt(1.0 / fan_in)
+        return jax.random.normal(key, shape, dtype) * std
+    if scheme == DISTRIBUTION:
+        return _sample_distribution(key, shape, distribution or {}, dtype)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'. Available: {ALL}")
+
+
+def _sample_distribution(key: jax.Array, shape, dist: dict, dtype) -> Array:
+    kind = dist.get("type", "normal").lower()
+    if kind in ("normal", "gaussian"):
+        mean = dist.get("mean", 0.0)
+        std = dist.get("std", 1.0)
+        return jax.random.normal(key, shape, dtype) * std + mean
+    if kind == "uniform":
+        lower = dist.get("lower", -1.0)
+        upper = dist.get("upper", 1.0)
+        return jax.random.uniform(key, shape, dtype, lower, upper)
+    if kind == "binomial":
+        n = dist.get("n", 1)
+        p = dist.get("p", 0.5)
+        draws = jax.random.bernoulli(key, p, (n,) + tuple(shape))
+        return jnp.sum(draws, axis=0).astype(dtype)
+    raise ValueError(f"Unknown distribution '{kind}'")

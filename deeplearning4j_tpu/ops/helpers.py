@@ -1,0 +1,120 @@
+"""Accelerated-op helper seam.
+
+Parity with the reference's per-layer `*Helper` plugin seam
+(nn/layers/convolution/ConvolutionHelper.java:29 + the cuDNN plugin module
+deeplearning4j-cuda-7.5, loaded reflectively at ConvolutionLayer.java:64-70
+with silent fallback). TPU redesign: the seam lives at the *op* level — a
+registry of implementations for conv2d / pool2d / batch_norm / lrn. The
+default impls are XLA-lowered lax ops (already MXU-tiled and fused); Pallas
+kernels register overrides via `register_helper` (see ops/pallas_kernels.py),
+and callers never change. `use_helper(name, None)` restores the default —
+the same silent-fallback semantics as the reference.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+_HELPERS: Dict[str, Callable] = {}
+
+
+def register_helper(name: str, fn: Optional[Callable]) -> None:
+    """Override the implementation of an op; None restores the default."""
+    if fn is None:
+        _HELPERS.pop(name, None)
+    else:
+        _HELPERS[name] = fn
+
+
+def get_helper(name: str) -> Optional[Callable]:
+    return _HELPERS.get(name)
+
+
+# -- conv2d --------------------------------------------------------------------
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv2d_default(x: Array, w: Array, *, stride, padding, dilation=(1, 1)) -> Array:
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=tuple(stride),
+        padding=padding,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=_DIMNUMS,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    ).astype(x.dtype)
+
+
+def conv2d(x: Array, w: Array, *, stride=(1, 1), padding="SAME", dilation=(1, 1)) -> Array:
+    """NHWC x HWIO -> NHWC convolution."""
+    impl = _HELPERS.get("conv2d", _conv2d_default)
+    return impl(x, w, stride=stride, padding=padding, dilation=dilation)
+
+
+# -- pool2d --------------------------------------------------------------------
+
+def _pool2d_default(x: Array, *, kind, kernel, stride, padding, pnorm=2) -> Array:
+    kh, kw = kernel
+    window = (1, kh, kw, 1)
+    strides = (1, stride[0], stride[1], 1)
+    if padding == "SAME":
+        pad = "SAME"
+    else:
+        (ph0, ph1), (pw0, pw1) = padding
+        pad = ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0))
+    kind = kind.lower()
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pad)
+    if kind in ("avg", "mean"):
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        ones = jnp.ones_like(x)
+        count = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
+        return s / count
+    if kind == "sum":
+        return lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+    if kind == "pnorm":
+        p = float(pnorm)
+        s = lax.reduce_window(jnp.power(jnp.abs(x), p), 0.0, lax.add, window, strides, pad)
+        return jnp.power(s, 1.0 / p)
+    raise ValueError(f"Unknown pooling kind '{kind}'")
+
+
+def pool2d(x: Array, *, kind="max", kernel=(2, 2), stride=(2, 2), padding="SAME", pnorm=2) -> Array:
+    impl = _HELPERS.get("pool2d", _pool2d_default)
+    return impl(x, kind=kind, kernel=kernel, stride=stride, padding=padding, pnorm=pnorm)
+
+
+# -- batch norm ----------------------------------------------------------------
+
+def _batch_norm_default(x, gamma, beta, mean, var, *, eps) -> Array:
+    inv = lax.rsqrt(var + eps)
+    return (x - mean) * inv * gamma + beta
+
+
+def batch_norm(x, gamma, beta, mean, var, *, eps=1e-5) -> Array:
+    impl = _HELPERS.get("batch_norm", _batch_norm_default)
+    return impl(x, gamma, beta, mean, var, eps=eps)
+
+
+# -- local response normalization ---------------------------------------------
+
+def _lrn_default(x: Array, *, k, n, alpha, beta) -> Array:
+    # cross-channel sliding-window sum of squares; NHWC channels-last
+    half = int(n) // 2
+    sq = x * x
+    window = (1, 1, 1, 2 * half + 1)
+    s = lax.reduce_window(sq, 0.0, lax.add, window, (1, 1, 1, 1),
+                          ((0, 0), (0, 0), (0, 0), (half, half)))
+    return x / jnp.power(k + alpha * s, beta)
+
+
+def lrn(x: Array, *, k=2.0, n=5.0, alpha=1e-4, beta=0.75) -> Array:
+    impl = _HELPERS.get("lrn", _lrn_default)
+    return impl(x, k=k, n=n, alpha=alpha, beta=beta)

@@ -1,0 +1,116 @@
+"""Loss functions matching the reference's LossFunctions enum semantics.
+
+Reference: ND4J `org.nd4j.linalg.lossfunctions.LossFunctions`/`LossCalculation`
+(consumed by deeplearning4j-core/.../nn/layers/BaseOutputLayer.java for scoring).
+Each loss takes (labels, preds) with optional per-example mask and returns the
+summed-over-outputs, mean-over-examples scalar score (the reference divides the
+batch sum by the number of examples at score time; see
+MultiLayerNetwork.java score path).
+
+All functions are pure and jit-safe; masks (for variable-length time series,
+reference `feedForward(input,fMask,lMask)` MultiLayerNetwork.java:711) are
+broadcast [batch, time] -> [batch*time, 1] by the RNN output layer before
+calling in here.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_EPS = 1e-8
+
+
+def _reduce(per_example: Array, mask: Optional[Array]) -> Array:
+    """Sum over output dims already done; average over (masked) examples."""
+    if mask is not None:
+        m = mask.reshape((per_example.shape[0],) + (1,) * (per_example.ndim - 1))
+        per_example = per_example * m.squeeze() if per_example.ndim == 1 else per_example * m
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(per_example) / denom
+    return jnp.mean(per_example)
+
+
+def mse(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    per_ex = jnp.sum((labels - preds) ** 2, axis=-1)
+    return _reduce(per_ex, mask)
+
+
+def squared_loss(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    return mse(labels, preds, mask)
+
+
+def l1(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    per_ex = jnp.sum(jnp.abs(labels - preds), axis=-1)
+    return _reduce(per_ex, mask)
+
+
+def l2(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    return mse(labels, preds, mask)
+
+
+def xent(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    """Binary cross entropy (reference XENT)."""
+    p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+    per_ex = -jnp.sum(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p), axis=-1)
+    return _reduce(per_ex, mask)
+
+
+def mcxent(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    """Multi-class cross entropy against probabilities (reference MCXENT)."""
+    p = jnp.clip(preds, _EPS, 1.0)
+    per_ex = -jnp.sum(labels * jnp.log(p), axis=-1)
+    return _reduce(per_ex, mask)
+
+
+def negativeloglikelihood(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    return mcxent(labels, preds, mask)
+
+
+def rmse_xent(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    per_ex = jnp.sqrt(jnp.sum((labels - preds) ** 2, axis=-1) + _EPS)
+    return _reduce(per_ex, mask)
+
+
+def expll(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    """Exponential log likelihood (Poisson-style, reference EXPLL)."""
+    p = jnp.clip(preds, _EPS, None)
+    per_ex = jnp.sum(p - labels * jnp.log(p), axis=-1)
+    return _reduce(per_ex, mask)
+
+
+def reconstruction_crossentropy(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    return xent(labels, preds, mask)
+
+
+def hinge(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
+    """Hinge loss; labels expected in {-1, +1} or one-hot (converted)."""
+    lab = jnp.where(labels > 0, 1.0, -1.0)
+    per_ex = jnp.sum(jnp.maximum(0.0, 1.0 - lab * preds), axis=-1)
+    return _reduce(per_ex, mask)
+
+
+LOSSES: dict[str, Callable[..., Array]] = {
+    "mse": mse,
+    "squared_loss": squared_loss,
+    "l1": l1,
+    "l2": l2,
+    "xent": xent,
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "nll": negativeloglikelihood,
+    "rmse_xent": rmse_xent,
+    "expll": expll,
+    "reconstruction_crossentropy": reconstruction_crossentropy,
+    "hinge": hinge,
+}
+
+
+def get(name: str) -> Callable[..., Array]:
+    try:
+        return LOSSES[name.lower()]
+    except KeyError:
+        raise ValueError(f"Unknown loss '{name}'. Available: {sorted(LOSSES)}") from None

@@ -1,0 +1,112 @@
+"""Config DSL + JSON/YAML round-trip tests.
+
+Mirrors the reference's nn/conf serde tests (MultiLayerNeuralNetConfigurationTest,
+ComputationGraphConfigurationTest JSON/YAML round-trips).
+"""
+import dataclasses
+
+from deeplearning4j_tpu import (Adam, InputType, MultiLayerConfiguration,
+                               Nesterovs, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               GravesLSTM, OutputLayer,
+                                               RnnOutputLayer,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+
+
+def lenet_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .learning_rate(0.01)
+            .updater(Nesterovs(momentum=0.9))
+            .regularization(True)
+            .l2(5e-4)
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+
+
+def test_json_roundtrip():
+    conf = lenet_conf()
+    js = conf.to_json()
+    restored = MultiLayerConfiguration.from_json(js)
+    assert restored.to_json() == js
+    assert len(restored.layers) == 6
+    assert isinstance(restored.layers[0], ConvolutionLayer)
+    assert restored.layers[0].kernel_size == (5, 5)
+    assert isinstance(restored.conf.updater, Nesterovs)
+    assert restored.conf.updater.momentum == 0.9
+
+
+def test_yaml_roundtrip():
+    conf = lenet_conf()
+    ym = conf.to_yaml()
+    restored = MultiLayerConfiguration.from_yaml(ym)
+    assert restored.to_json() == conf.to_json()
+
+
+def test_shape_inference_lenet():
+    conf = lenet_conf()
+    # conv(5x5, no pad): 28->24, pool: 12, conv: 8, pool: 4 -> dense in 4*4*50
+    assert conf.layers[0].n_in == 1
+    assert conf.layers[2].n_in == 20
+    assert conf.layers[4].n_in == 4 * 4 * 50
+    assert conf.layers[5].n_in == 500
+    proc = conf.preprocessor(4)
+    assert isinstance(proc, CnnToFeedForwardPreProcessor)
+
+
+def test_global_defaults_resolved_into_layers():
+    conf = (NeuralNetConfiguration.builder()
+            .learning_rate(0.05)
+            .activation("tanh")
+            .weight_init("relu")
+            .regularization(True)
+            .l2(1e-3)
+            .updater(Adam())
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax"))
+            .build())
+    d = conf.layers[0]
+    assert d.activation == "tanh"
+    assert d.weight_init == "relu"
+    assert d.l2 == 1e-3
+    assert d.learning_rate == 0.05
+    assert isinstance(d.updater, Adam)
+    # per-layer override wins
+    assert conf.layers[1].activation == "softmax"
+
+
+def test_rnn_conf_shape_inference():
+    conf = (NeuralNetConfiguration.builder()
+            .list()
+            .layer(GravesLSTM(n_out=20, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(10))
+            .build())
+    assert conf.layers[0].n_in == 10
+    assert conf.layers[1].n_in == 20
+
+
+def test_batchnorm_shape_inference():
+    conf = (NeuralNetConfiguration.builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3), padding=(1, 1)))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.convolutional(8, 8, 3))
+            .build())
+    assert conf.layers[1].n_out == 8  # per-channel
+    assert conf.layers[2].n_in == 8 * 8 * 8

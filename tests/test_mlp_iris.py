@@ -1,0 +1,91 @@
+"""End-to-end MLP on Iris — the first full slice.
+
+Mirrors the reference's BackPropMLPTest + MultiLayerTest
+(deeplearning4j-core/src/test/java/org/deeplearning4j/nn/multilayer/):
+score decreases during training, accuracy is high after a few epochs,
+output/predict/evaluate work.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, Evaluation, InputType, ListDataSetIterator,
+                               MultiLayerNetwork, MultipleEpochsIterator,
+                               NeuralNetConfiguration, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.datasets.fetchers import IrisDataSetIterator, load_iris_dataset
+
+
+def build_iris_net(updater=None, lr=0.1, seed=12345):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .learning_rate(lr)
+            .updater(updater or Sgd())
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="tanh"))
+            .layer(DenseLayer(n_out=16, n_in=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_score_decreases():
+    net = build_iris_net(lr=0.1)
+    ds = load_iris_dataset()
+    initial = net.score(x=ds.features, y=ds.labels)
+    it = MultipleEpochsIterator(30, ListDataSetIterator(ds, batch=50))
+    net.fit(it)
+    final = net.score(x=ds.features, y=ds.labels)
+    assert final < initial * 0.5, f"score did not improve: {initial} -> {final}"
+
+
+def test_iris_accuracy():
+    net = build_iris_net(updater=Adam(), lr=0.01)
+    it = MultipleEpochsIterator(60, IrisDataSetIterator(batch=50))
+    net.fit(it)
+    ev = net.evaluate(IrisDataSetIterator(batch=150))
+    assert ev.accuracy() > 0.9, ev.stats()
+    assert 0.0 < ev.f1() <= 1.0
+
+
+def test_output_shapes_and_predict():
+    net = build_iris_net()
+    x = np.random.default_rng(0).normal(size=(7, 4)).astype(np.float32)
+    out = net.output(x)
+    assert out.shape == (7, 3)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+    preds = net.predict(x)
+    assert preds.shape == (7,)
+    acts = net.feed_forward(x)
+    assert len(acts) == 4  # input + 3 layers
+    assert acts[1].shape == (7, 16)
+
+
+def test_deterministic_init_with_seed():
+    a = build_iris_net(seed=99).params_flat()
+    b = build_iris_net(seed=99).params_flat()
+    c = build_iris_net(seed=100).params_flat()
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_fit_xy_arrays_and_score():
+    net = build_iris_net(lr=0.5)
+    ds = load_iris_dataset()
+    s0 = net.score(x=ds.features, y=ds.labels)
+    for _ in range(20):
+        net.fit(ds.features, ds.labels)
+    assert net.score_ < s0
+    assert net.num_params() == 4 * 16 + 16 + 16 * 16 + 16 + 16 * 3 + 3
+
+
+def test_params_flat_roundtrip():
+    net = build_iris_net()
+    flat = net.params_flat()
+    net2 = build_iris_net(seed=777)
+    net2.set_params_flat(flat)
+    np.testing.assert_array_equal(net2.params_flat(), flat)
+    x = np.ones((3, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)), rtol=1e-6)
