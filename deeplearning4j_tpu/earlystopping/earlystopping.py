@@ -1,0 +1,243 @@
+"""Early stopping: configuration, termination conditions, trainers, savers.
+
+Parity with the reference `earlystopping/` package (SURVEY.md §2.2):
+EarlyStoppingConfiguration, epoch/iteration/score/time termination conditions,
+BaseEarlyStoppingTrainer.fit():82 per-epoch loop with best-model tracking,
+InMemoryModelSaver / LocalFileModelSaver, scorecalc/DataSetLossCalculator.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, List, Optional
+
+
+# -- score calculators ---------------------------------------------------------
+
+class ScoreCalculator:
+    def calculate_score(self, net) -> float:
+        raise NotImplementedError
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    """Average loss over an iterator (reference scorecalc/DataSetLossCalculator)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, net) -> float:
+        total, n = 0.0, 0
+        self.iterator.reset()
+        for ds in self.iterator:
+            total += net.score(ds) * ds.num_examples()
+            n += ds.num_examples()
+        if n == 0:
+            return float("nan")
+        return total / n if self.average else total
+
+
+# -- termination conditions ----------------------------------------------------
+
+class EpochTerminationCondition:
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def terminate(self, last_score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score):
+        return epoch >= self.max_epochs - 1
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after N epochs without improvement (reference same name)."""
+
+    def __init__(self, max_epochs_without_improvement: int, min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+        self._best = float("inf")
+        self._bad_epochs = 0
+
+    def terminate(self, epoch, score):
+        if score < self._best - self.min_improvement:
+            self._best = score
+            self._bad_epochs = 0
+        else:
+            self._bad_epochs += 1
+        return self._bad_epochs > self.patience
+
+
+class BestScoreEpochTerminationCondition(EpochTerminationCondition):
+    def __init__(self, best_expected_score: float):
+        self.best = best_expected_score
+
+    def terminate(self, epoch, score):
+        return score < self.best
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start = time.time()
+
+    def terminate(self, last_score):
+        return (time.time() - self._start) > self.max_seconds
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Terminate if score explodes (reference same name)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, last_score):
+        return last_score > self.max_score or last_score != last_score  # NaN
+
+
+# -- model savers --------------------------------------------------------------
+
+class EarlyStoppingModelSaver:
+    def save_best_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+    def get_latest_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(EarlyStoppingModelSaver):
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score):
+        self._best = net.clone()
+
+    def save_latest_model(self, net, score):
+        self._latest = net.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver(EarlyStoppingModelSaver):
+    """Zip-checkpoint saver (reference saver/LocalFileModelSaver)."""
+
+    def __init__(self, directory: str):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _best_path(self):
+        return self.dir / "bestModel.zip"
+
+    def _latest_path(self):
+        return self.dir / "latestModel.zip"
+
+    def save_best_model(self, net, score):
+        from ..util import model_serializer
+        model_serializer.write_model(net, self._best_path())
+
+    def save_latest_model(self, net, score):
+        from ..util import model_serializer
+        model_serializer.write_model(net, self._latest_path())
+
+    def get_best_model(self):
+        from ..util import model_serializer
+        return model_serializer.restore_multi_layer_network(self._best_path())
+
+    def get_latest_model(self):
+        from ..util import model_serializer
+        return model_serializer.restore_multi_layer_network(self._latest_path())
+
+
+# -- configuration + result ----------------------------------------------------
+
+@dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: ScoreCalculator = None
+    model_saver: EarlyStoppingModelSaver = field(default_factory=InMemoryModelSaver)
+    epoch_termination_conditions: List[EpochTerminationCondition] = field(default_factory=list)
+    iteration_termination_conditions: List[IterationTerminationCondition] = field(default_factory=list)
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+
+@dataclass
+class EarlyStoppingResult:
+    termination_reason: str = ""
+    termination_details: str = ""
+    total_epochs: int = 0
+    best_model_epoch: int = -1
+    best_model_score: float = float("inf")
+    score_vs_epoch: dict = field(default_factory=dict)
+    best_model: Any = None
+
+
+class EarlyStoppingTrainer:
+    """Per-epoch early-stopping fit loop (reference BaseEarlyStoppingTrainer.fit:82)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iterator):
+        self.config = config
+        self.net = net
+        self.iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        result = EarlyStoppingResult()
+        epoch = 0
+        while True:
+            self.iterator.reset()
+            terminated_iter = False
+            for ds in self.iterator:
+                self.net.fit(ds)
+                for cond in cfg.iteration_termination_conditions:
+                    if cond.terminate(self.net.score_):
+                        result.termination_reason = "IterationTerminationCondition"
+                        result.termination_details = type(cond).__name__
+                        terminated_iter = True
+                        break
+                if terminated_iter:
+                    break
+            if terminated_iter:
+                break
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(self.net)
+                result.score_vs_epoch[epoch] = score
+                if score < result.best_model_score:
+                    result.best_model_score = score
+                    result.best_model_epoch = epoch
+                    cfg.model_saver.save_best_model(self.net, score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.net, score)
+                stop = False
+                for cond in cfg.epoch_termination_conditions:
+                    if cond.terminate(epoch, score):
+                        result.termination_reason = "EpochTerminationCondition"
+                        result.termination_details = type(cond).__name__
+                        stop = True
+                        break
+                if stop:
+                    break
+            epoch += 1
+        result.total_epochs = epoch + 1
+        result.best_model = cfg.model_saver.get_best_model()
+        return result

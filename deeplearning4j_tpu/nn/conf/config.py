@@ -21,8 +21,8 @@ from . import serde
 from .inputs import (ConvolutionalFlatInputType, ConvolutionalInputType,
                      FeedForwardInputType, InputType, RecurrentInputType)
 from .layers import (ActivationLayer, BatchNormalization, ConvolutionLayer,
-                     DropoutLayer, Layer, LocalResponseNormalization,
-                     SubsamplingLayer)
+                     DropoutLayer, FeedForwardLayer, Layer,
+                     LocalResponseNormalization, SubsamplingLayer)
 from .preprocessors import (CnnToFeedForwardPreProcessor,
                             CnnToRnnPreProcessor,
                             FeedForwardToCnnPreProcessor,
@@ -238,6 +238,8 @@ class ListBuilder:
         preprocessors = dict(self._preprocessors)
         if self._input_type is not None:
             _infer_shapes(layers, preprocessors, self._input_type)
+        else:
+            _chain_nin_from_nout(layers)
         return MultiLayerConfiguration(
             conf=self._conf,
             layers=layers,
@@ -273,6 +275,25 @@ def resolve_layer_defaults(layer: Layer, conf: NeuralNetConfiguration) -> Layer:
         if getattr(layer, name, None) is None:
             setattr(layer, name, copy.deepcopy(value))
     return layer
+
+
+def _chain_nin_from_nout(layers: List[Layer]) -> None:
+    """Without an explicit InputType, wire missing n_in from the previous
+    layer's n_out (covers BatchNorm and dense/rnn chains where the reference
+    requires explicit nIn). Conv/subsampling layers break the chain: their
+    n_out is a channel count, not a flat size — those need set_input_type()."""
+    prev = None
+    for layer in layers:
+        if isinstance(layer, (ConvolutionLayer, SubsamplingLayer)) or not isinstance(
+                layer, FeedForwardLayer):
+            prev = None
+            continue
+        if layer.n_in is None and prev is not None:
+            layer.set_n_in(InputType.feed_forward(prev))
+        if layer.n_out is not None:
+            prev = layer.n_out
+        elif not isinstance(layer, BatchNormalization):
+            prev = None
 
 
 # -- automatic shape inference (ConvolutionLayerSetup equivalent) --------------

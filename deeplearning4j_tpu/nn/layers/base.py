@@ -77,12 +77,17 @@ class LayerImpl:
     def reg_loss(self, params: Params) -> Array:
         l1 = float(getattr(self.conf, "l1", 0.0) or 0.0)
         l2 = float(getattr(self.conf, "l2", 0.0) or 0.0)
-        total = jnp.asarray(0.0, jnp.float32)
+        acc_dtype = jnp.float32
+        for k in self.WEIGHT_KEYS:
+            if k in params:
+                acc_dtype = jnp.promote_types(params[k].dtype, jnp.float32)
+                break
+        total = jnp.asarray(0.0, acc_dtype)
         if l1 == 0.0 and l2 == 0.0:
             return total
         for k in self.WEIGHT_KEYS:
             if k in params:
-                w = params[k].astype(jnp.float32)
+                w = params[k].astype(acc_dtype)
                 if l2:
                     total = total + 0.5 * l2 * jnp.sum(w * w)
                 if l1:

@@ -96,7 +96,7 @@ class Adam(UpdaterConfig):
         return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
 
     def apply(self, state, grad, lr, step):
-        t = (step + 1).astype(grad.dtype)
+        t = jnp.asarray(step + 1, grad.dtype)
         b1 = jnp.asarray(self.beta1, grad.dtype)
         b2 = jnp.asarray(self.beta2, grad.dtype)
         m = b1 * state["m"] + (1.0 - b1) * grad
@@ -168,7 +168,7 @@ class AdaMax(UpdaterConfig):
         return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
 
     def apply(self, state, grad, lr, step):
-        t = (step + 1).astype(grad.dtype)
+        t = jnp.asarray(step + 1, grad.dtype)
         b1 = jnp.asarray(self.beta1, grad.dtype)
         m = b1 * state["m"] + (1.0 - b1) * grad
         u = jnp.maximum(self.beta2 * state["u"], jnp.abs(grad))

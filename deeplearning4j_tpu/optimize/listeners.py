@@ -1,0 +1,98 @@
+"""IterationListener SPI + standard listeners.
+
+Parity with the reference `optimize/api/IterationListener` — the universal
+observability hook (SURVEY.md §5) — and `optimize/listeners/*`:
+ScoreIterationListener, ParamAndGradientIterationListener,
+ComposableIterationListener, plus a CollectScoresIterationListener and a
+time-per-iteration listener (the SparkTrainingStats-style phase timing hook
+for single-host training).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    """Called after each parameter update (reference IterationListener.iterationDone)."""
+
+    def iteration_done(self, model, iteration: int) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    """Log the score every N iterations (reference ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10, log_fn: Optional[Callable] = None):
+        self.n = max(1, print_iterations)
+        self._log = log_fn or (lambda msg: logger.info(msg))
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.n == 0:
+            self._log(f"Score at iteration {iteration} is {model.score_}")
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Collect (iteration, score) pairs in memory (reference CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score_))
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Per-parameter norms/means every N iterations
+    (reference ParamAndGradientIterationListener)."""
+
+    def __init__(self, iterations: int = 1, log_fn: Optional[Callable] = None):
+        self.n = max(1, iterations)
+        self._log = log_fn or (lambda msg: logger.info(msg))
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.n != 0:
+            return
+        lines = [f"iter {iteration} score {model.score_}"]
+        for i, lp in enumerate(model.params):
+            for name, arr in lp.items():
+                a = np.asarray(arr)
+                lines.append(f"  L{i}.{name}: mean={a.mean():.3e} "
+                             f"absmax={np.abs(a).max():.3e} l2={np.linalg.norm(a):.3e}")
+        self._log("\n".join(lines))
+
+
+class ComposableIterationListener(IterationListener):
+    """Fan out to several listeners (reference ComposableIterationListener)."""
+
+    def __init__(self, *listeners: IterationListener):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration):
+        for l in self.listeners:
+            l.iteration_done(model, iteration)
+
+
+class TimeIterationListener(IterationListener):
+    """Wall-time per iteration; the single-host analog of the reference's
+    StatsCalculationHelper phase timing."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.times: List[float] = []
+        self._last = time.perf_counter()
+
+    def iteration_done(self, model, iteration):
+        now = time.perf_counter()
+        self.times.append(now - self._last)
+        self._last = now
+
+    def mean_iteration_seconds(self) -> float:
+        return float(np.mean(self.times)) if self.times else 0.0

@@ -1,12 +1,20 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX is imported.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's local-mode Spark testing strategy
 (/root/reference/deeplearning4j-scaleout/spark/dl4j-spark/src/test/java/org/deeplearning4j/spark/BaseSparkTest.java:90
 `.setMaster("local[n]")`): distributed logic runs multi-"device" in one process.
+
+Note: the env var JAX_PLATFORMS alone is NOT enough here — the site
+customization re-forces the TPU platform at startup — so we also set the
+config flag after import, before any backend is initialized.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,106 @@
+"""Early stopping + listener tests (reference earlystopping/TestEarlyStopping,
+optimize/listeners tests)."""
+import numpy as np
+
+from deeplearning4j_tpu import (Adam, ListDataSetIterator, MultiLayerNetwork,
+                               NeuralNetConfiguration, Sgd)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+from deeplearning4j_tpu.earlystopping.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.optimize.listeners import (CollectScoresIterationListener,
+                                                   ComposableIterationListener,
+                                                   ScoreIterationListener,
+                                                   TimeIterationListener)
+
+
+def _net(lr=0.05):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(lr).updater(Adam())
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_in=12, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_early_stopping_max_epochs(tmp_path):
+    ds = load_iris_dataset()
+    train, test = ds.split_test_and_train(120)
+    esc = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator(test, 30)),
+        model_saver=InMemoryModelSaver(),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(8)],
+    )
+    trainer = EarlyStoppingTrainer(esc, _net(), ListDataSetIterator(train, 40))
+    result = trainer.fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.total_epochs == 8
+    assert result.best_model is not None
+    assert result.best_model_score < 1.5
+    # best model is usable
+    ev = result.best_model.evaluate(ListDataSetIterator(test, 30))
+    assert ev.accuracy() > 0.5
+
+
+def test_early_stopping_patience():
+    ds = load_iris_dataset()
+    train, test = ds.split_test_and_train(120)
+    esc = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator(test, 30)),
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(100),
+            ScoreImprovementEpochTerminationCondition(2, min_improvement=1e9),
+        ],
+    )
+    trainer = EarlyStoppingTrainer(esc, _net(), ListDataSetIterator(train, 40))
+    result = trainer.fit()
+    # impossible min_improvement -> stops after patience epochs
+    assert result.total_epochs <= 5
+
+
+def test_early_stopping_score_explosion():
+    ds = load_iris_dataset()
+    esc = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator(ds, 50)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(50)],
+        iteration_termination_conditions=[MaxScoreIterationTerminationCondition(1e-12)],
+    )
+    trainer = EarlyStoppingTrainer(esc, _net(), ListDataSetIterator(ds, 50))
+    result = trainer.fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+
+
+def test_local_file_saver_roundtrip(tmp_path):
+    ds = load_iris_dataset()
+    esc = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator(ds, 50)),
+        model_saver=LocalFileModelSaver(str(tmp_path)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+    )
+    result = EarlyStoppingTrainer(esc, _net(), ListDataSetIterator(ds, 50)).fit()
+    assert (tmp_path / "bestModel.zip").exists()
+    best = result.best_model
+    assert best.num_params() == 4 * 12 + 12 + 12 * 3 + 3
+
+
+def test_listeners_fire():
+    ds = load_iris_dataset()
+    net = _net()
+    collect = CollectScoresIterationListener()
+    timer = TimeIterationListener()
+    seen = []
+    score_listener = ScoreIterationListener(print_iterations=2,
+                                            log_fn=lambda m: seen.append(m))
+    net.set_listeners(ComposableIterationListener(collect, timer), score_listener)
+    for _ in range(6):
+        net.fit(ds.features[:50], ds.labels[:50])
+    assert len(collect.scores) == 6
+    assert len(timer.times) == 6
+    assert any("Score at iteration" in m for m in seen)
+    scores = [s for _, s in collect.scores]
+    assert scores[-1] < scores[0]

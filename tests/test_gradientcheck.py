@@ -1,0 +1,170 @@
+"""Numerical vs analytic gradient checks per layer type.
+
+Mirrors the reference's gradientcheck suite (GradientCheckTests.java:33-34 —
+eps=1e-6, maxRelError=1e-3, double precision — plus CNNGradientCheckTest,
+BNGradientCheckTest, GradientCheckTestsMasking). Runs in float64 via the
+jax_enable_x64 fixture.
+"""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork, Sgd
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               EmbeddingLayer,
+                                               GlobalPoolingLayer,
+                                               GravesBidirectionalLSTM,
+                                               GravesLSTM, GRU, LSTM,
+                                               LocalResponseNormalization,
+                                               OutputLayer, RnnOutputLayer,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.util.gradientcheck import check_gradients
+
+EPS = 1e-6
+MAX_REL = 1e-3
+
+
+@pytest.fixture
+def x64():
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", False)
+
+
+def _net(*layers, input_type=None, l1=0.0, l2=0.0, seed=42):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .dtype("float64")
+         .updater(Sgd())
+         .regularization(l1 > 0 or l2 > 0)
+         .l1(l1)
+         .l2(l2)
+         .list())
+    for l in layers:
+        b.layer(l)
+    if input_type is not None:
+        b.set_input_type(input_type)
+    return MultiLayerNetwork(b.build()).init()
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape)
+
+
+def _onehot(n, c, seed=1):
+    rng = np.random.default_rng(seed)
+    y = np.zeros((n, c))
+    y[np.arange(n), rng.integers(0, c, n)] = 1.0
+    return y
+
+
+@pytest.mark.parametrize("act,loss,out_act", [
+    ("tanh", "mse", "identity"),
+    ("relu", "negativeloglikelihood", "softmax"),
+    ("sigmoid", "xent", "sigmoid"),
+    ("elu", "mcxent", "softmax"),
+])
+def test_mlp_gradients(x64, act, loss, out_act):
+    net = _net(DenseLayer(n_in=4, n_out=5, activation=act),
+               OutputLayer(n_in=5, n_out=3, activation=out_act, loss=loss))
+    x = _rand((6, 4))
+    y = (_onehot(6, 3) if out_act == "softmax"
+         else np.abs(_rand((6, 3), 2)) % 1.0 if out_act == "sigmoid"
+         else _rand((6, 3), 2))
+    assert check_gradients(net, x, y, EPS, MAX_REL)
+
+
+def test_mlp_l1_l2_gradients(x64):
+    net = _net(DenseLayer(n_in=4, n_out=5, activation="tanh"),
+               OutputLayer(n_in=5, n_out=3, activation="softmax",
+                           loss="negativeloglikelihood"),
+               l1=0.01, l2=0.02)
+    assert check_gradients(net, _rand((5, 4)), _onehot(5, 3), EPS, MAX_REL)
+
+
+def test_cnn_gradients(x64):
+    net = _net(ConvolutionLayer(n_out=3, kernel_size=(2, 2), stride=(1, 1),
+                                activation="tanh"),
+               SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)),
+               OutputLayer(n_out=2, activation="softmax", loss="negativeloglikelihood"),
+               input_type=InputType.convolutional(6, 6, 2))
+    x = _rand((4, 6, 6, 2))
+    assert check_gradients(net, x, _onehot(4, 2), EPS, MAX_REL)
+
+
+def test_cnn_avgpool_gradients(x64):
+    net = _net(ConvolutionLayer(n_out=2, kernel_size=(3, 3), padding=(1, 1),
+                                activation="sigmoid"),
+               SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2), stride=(2, 2)),
+               OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+               input_type=InputType.convolutional(4, 4, 1))
+    x = _rand((3, 4, 4, 1))
+    assert check_gradients(net, x, _onehot(3, 3), EPS, MAX_REL)
+
+
+def test_batchnorm_gradients(x64):
+    net = _net(DenseLayer(n_in=4, n_out=6, activation="identity"),
+               BatchNormalization(),
+               ActivationLayer(activation="relu"),
+               OutputLayer(n_in=6, n_out=3, activation="softmax",
+                           loss="negativeloglikelihood"))
+    assert check_gradients(net, _rand((8, 4)), _onehot(8, 3), EPS, MAX_REL)
+
+
+def test_lrn_gradients(x64):
+    net = _net(ConvolutionLayer(n_out=4, kernel_size=(2, 2), activation="relu"),
+               LocalResponseNormalization(),
+               OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+               input_type=InputType.convolutional(5, 5, 1))
+    x = np.abs(_rand((3, 5, 5, 1)))
+    assert check_gradients(net, x, _onehot(3, 2), EPS, MAX_REL)
+
+
+@pytest.mark.parametrize("rnn_layer", [
+    lambda: GravesLSTM(n_in=3, n_out=4, activation="tanh"),
+    lambda: LSTM(n_in=3, n_out=4, activation="tanh"),
+    lambda: GRU(n_in=3, n_out=4, activation="tanh"),
+    lambda: GravesBidirectionalLSTM(n_in=3, n_out=4, activation="tanh"),
+])
+def test_rnn_gradients(x64, rnn_layer):
+    net = _net(rnn_layer(),
+               RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss="mcxent"))
+    B, T = 3, 5
+    x = _rand((B, T, 3))
+    y = np.zeros((B, T, 2))
+    rng = np.random.default_rng(3)
+    y[np.arange(B)[:, None], np.arange(T)[None, :], rng.integers(0, 2, (B, T))] = 1.0
+    assert check_gradients(net, x, y, EPS, MAX_REL)
+
+
+def test_rnn_masking_gradients(x64):
+    """Variable-length time series (reference GradientCheckTestsMasking)."""
+    net = _net(GravesLSTM(n_in=3, n_out=4, activation="tanh"),
+               RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss="mcxent"))
+    B, T = 3, 5
+    x = _rand((B, T, 3))
+    y = np.zeros((B, T, 2))
+    y[:, :, 0] = 1.0
+    mask = np.ones((B, T))
+    mask[0, 3:] = 0
+    mask[1, 1:] = 0
+    assert check_gradients(net, x, y, EPS, MAX_REL, fmask=mask, lmask=mask)
+
+
+def test_embedding_gradients(x64):
+    net = _net(EmbeddingLayer(n_in=7, n_out=4, activation="identity"),
+               OutputLayer(n_in=4, n_out=3, activation="softmax",
+                           loss="negativeloglikelihood"))
+    x = np.random.default_rng(5).integers(0, 7, (6, 1))
+    assert check_gradients(net, x, _onehot(6, 3), EPS, MAX_REL)
+
+
+def test_global_pooling_gradients(x64):
+    net = _net(GravesLSTM(n_in=3, n_out=4, activation="tanh"),
+               GlobalPoolingLayer(pooling_type="avg"),
+               OutputLayer(n_in=4, n_out=2, activation="softmax", loss="mcxent"))
+    x = _rand((3, 4, 3))
+    assert check_gradients(net, x, _onehot(3, 2), EPS, MAX_REL)

@@ -1,0 +1,64 @@
+"""Checkpoint save/restore tests (reference util/ModelSerializerTest)."""
+import numpy as np
+
+from deeplearning4j_tpu import (Adam, MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization, DenseLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+from deeplearning4j_tpu.util import model_serializer
+
+
+def _trained_net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.05).updater(Adam())
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=10, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_in=10, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = load_iris_dataset()
+    for _ in range(5):
+        net.fit(ds.features[:64], ds.labels[:64])
+    return net, ds
+
+
+def test_save_restore_equality(tmp_path):
+    net, ds = _trained_net()
+    path = tmp_path / "model.zip"
+    model_serializer.write_model(net, path)
+    restored = model_serializer.restore_multi_layer_network(path)
+    np.testing.assert_array_equal(net.params_flat(), restored.params_flat())
+    np.testing.assert_array_equal(net.updater_state_flat(),
+                                  restored.updater_state_flat())
+    # batch norm running stats restored
+    np.testing.assert_allclose(np.asarray(net.variables[1]["mean"]),
+                               np.asarray(restored.variables[1]["mean"]), rtol=1e-6)
+    out1 = np.asarray(net.output(ds.features[:16]))
+    out2 = np.asarray(restored.output(ds.features[:16]))
+    np.testing.assert_allclose(out1, out2, rtol=1e-5)
+    assert restored.step == net.step
+
+
+def test_training_resumes_identically(tmp_path):
+    """Save mid-training; continued training must match uninterrupted run."""
+    net, ds = _trained_net()
+    path = tmp_path / "mid.zip"
+    model_serializer.write_model(net, path)
+    restored = model_serializer.restore_multi_layer_network(path)
+    # fix rng key for both nets so dropout-free updates are comparable
+    x, y = ds.features[:64], ds.labels[:64]
+    for _ in range(3):
+        net.fit(x, y)
+        restored.fit(x, y)
+    np.testing.assert_allclose(net.params_flat(), restored.params_flat(),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_restore_without_updater(tmp_path):
+    net, _ = _trained_net()
+    path = tmp_path / "nou.zip"
+    model_serializer.write_model(net, path, save_updater=False)
+    restored = model_serializer.restore_multi_layer_network(path)
+    np.testing.assert_array_equal(net.params_flat(), restored.params_flat())

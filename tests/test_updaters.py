@@ -1,0 +1,122 @@
+"""Updater math, LR schedules, gradient normalization.
+
+Mirrors the reference nn/updater tests (TestUpdaters, TestDecayPolicies,
+TestGradientNormalization): known-value checks of each updater kernel.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.updater.updaters import (AdaDelta, AdaGrad, Adam,
+                                                    Nesterovs, NoOp, RmsProp,
+                                                    Sgd, resolve_updater)
+from deeplearning4j_tpu.nn.updater.schedules import effective_lr
+from deeplearning4j_tpu.nn.updater.gradnorm import apply_gradient_normalization
+
+
+def _g():
+    return jnp.asarray([1.0, -2.0, 3.0], jnp.float32)
+
+
+def test_sgd():
+    delta, _ = Sgd().apply({}, _g(), jnp.float32(0.1), 0)
+    np.testing.assert_allclose(np.asarray(delta), [-0.1, 0.2, -0.3], rtol=1e-6)
+
+
+def test_noop():
+    delta, _ = NoOp().apply({}, _g(), jnp.float32(0.1), 0)
+    np.testing.assert_allclose(np.asarray(delta), [-1.0, 2.0, -3.0], rtol=1e-6)
+
+
+def test_nesterovs_two_steps():
+    u = Nesterovs(momentum=0.9)
+    p = jnp.zeros(3)
+    state = u.init_state(p)
+    g, lr = _g(), jnp.float32(0.1)
+    # step 1: v1 = -lr*g; delta = (1+mu)*v1
+    delta, state = u.apply(state, g, lr, 0)
+    np.testing.assert_allclose(np.asarray(delta), np.asarray(-(1.9) * 0.1 * g), rtol=1e-5)
+    # step 2: v2 = mu*v1 - lr*g; delta = (1+mu)*v2 - mu*v1
+    v1 = -0.1 * np.asarray(g)
+    v2 = 0.9 * v1 - 0.1 * np.asarray(g)
+    delta2, _ = u.apply(state, g, lr, 1)
+    np.testing.assert_allclose(np.asarray(delta2), 1.9 * v2 - 0.9 * v1, rtol=1e-5)
+
+
+def test_adam_first_step_magnitude():
+    u = Adam()
+    state = u.init_state(jnp.zeros(3))
+    delta, state = u.apply(state, _g(), jnp.float32(0.001), 0)
+    # first Adam step is ~ -lr * sign(g)
+    np.testing.assert_allclose(np.asarray(delta),
+                               [-0.001, 0.001, -0.001], rtol=1e-3)
+
+
+def test_adagrad():
+    u = AdaGrad(epsilon=0.0)
+    state = u.init_state(jnp.zeros(3))
+    delta, state = u.apply(state, _g(), jnp.float32(0.5), 0)
+    np.testing.assert_allclose(np.asarray(delta), [-0.5, 0.5, -0.5], rtol=1e-5)
+    # second identical step: h = 2g^2 -> delta = -lr/sqrt(2)
+    delta2, _ = u.apply(state, _g(), jnp.float32(0.5), 1)
+    np.testing.assert_allclose(np.asarray(delta2),
+                               np.asarray([-0.5, 0.5, -0.5]) / np.sqrt(2), rtol=1e-5)
+
+
+def test_rmsprop_decreases_step_for_large_grads():
+    u = RmsProp(rms_decay=0.9)
+    state = u.init_state(jnp.zeros(2))
+    g = jnp.asarray([10.0, 0.1])
+    delta, _ = u.apply(state, g, jnp.float32(0.01), 0)
+    d = np.abs(np.asarray(delta))
+    assert d[0] == pytest.approx(d[1], rel=1e-3)  # normalized per-element
+
+
+def test_adadelta_no_lr_needed():
+    u = AdaDelta(rho=0.9)
+    state = u.init_state(jnp.zeros(3))
+    delta, state = u.apply(state, _g(), jnp.float32(123.0), 0)
+    assert np.all(np.isfinite(np.asarray(delta)))
+    assert np.abs(np.asarray(delta)).max() < 0.1  # lr-free, small first step
+
+
+def test_resolve_updater_strings():
+    assert isinstance(resolve_updater("adam"), Adam)
+    assert isinstance(resolve_updater("nesterovs"), Nesterovs)
+    with pytest.raises(ValueError):
+        resolve_updater("adamw2")
+
+
+# -- schedules -----------------------------------------------------------------
+
+def test_lr_policies():
+    assert float(effective_lr(0.1, 5, "none")) == pytest.approx(0.1)
+    assert float(effective_lr(0.1, 2, "exponential", decay_rate=0.5)) == pytest.approx(0.025)
+    assert float(effective_lr(0.1, 3, "inverse", decay_rate=1.0, power=1.0)) == pytest.approx(0.025)
+    assert float(effective_lr(0.1, 10, "step", decay_rate=0.5, steps=5)) == pytest.approx(0.025)
+    assert float(effective_lr(0.1, 5, "poly", power=1.0, max_iterations=10)) == pytest.approx(0.05)
+    sched = {"0": 0.1, "5": 0.01, "8": 0.001}
+    assert float(effective_lr(0.1, 6, "schedule", schedule=sched)) == pytest.approx(0.01)
+    assert float(effective_lr(0.1, 9, "schedule", schedule=sched)) == pytest.approx(0.001)
+
+
+# -- gradient normalization ----------------------------------------------------
+
+def test_grad_clip_elementwise():
+    g = {"W": jnp.asarray([3.0, -4.0]), "b": jnp.asarray([0.5])}
+    out = apply_gradient_normalization(g, "ClipElementWiseAbsoluteValue", 1.0)
+    np.testing.assert_allclose(np.asarray(out["W"]), [1.0, -1.0])
+    np.testing.assert_allclose(np.asarray(out["b"]), [0.5])
+
+
+def test_grad_renorm_per_layer():
+    g = {"W": jnp.asarray([3.0, 4.0])}
+    out = apply_gradient_normalization(g, "RenormalizeL2PerLayer")
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out["W"])), 1.0, rtol=1e-5)
+
+
+def test_grad_clip_l2_per_param():
+    g = {"W": jnp.asarray([30.0, 40.0]), "b": jnp.asarray([0.1])}
+    out = apply_gradient_normalization(g, "ClipL2PerParamType", 5.0)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out["W"])), 5.0, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(out["b"]), [0.1], rtol=1e-5)
