@@ -1,0 +1,74 @@
+"""Benchmark: LeNet-MNIST MultiLayerNetwork.fit() examples/sec/chip.
+
+The primary BASELINE.md metric. The reference publishes no numbers
+(BASELINE.json `published:{}`); `vs_baseline` is therefore reported against a
+fixed nominal of 10,000 ex/s — a generous stand-in for nd4j-cuda-7.5-class
+throughput on this workload — until a measured reference baseline exists.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "examples/sec/chip", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NOMINAL_BASELINE = 10000.0  # examples/sec; see module docstring
+BATCH = 512
+WARMUP_STEPS = 5
+TIMED_STEPS = 200
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    platform = jax.devices()[0].platform
+    # bfloat16 compute on TPU (MXU-native), float32 elsewhere
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    net = MultiLayerNetwork(lenet_mnist(dtype=dtype)).init()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(BATCH, 28, 28, 1)), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)])
+
+    step_fn = net._get_train_step((False, False, False))
+
+    def one_step():
+        net._key, sub = jax.random.split(net._key)
+        out = step_fn(net.params, net.variables, net.updater_state,
+                      jnp.asarray(net.step), sub, x, y, None, None, None)
+        net.params, net.variables, net.updater_state = out[0], out[1], out[2]
+        net.step += 1
+        return out[3]
+
+    for _ in range(WARMUP_STEPS):
+        loss = one_step()
+    jax.block_until_ready(net.params)
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        loss = one_step()
+    jax.block_until_ready(net.params)
+    elapsed = time.perf_counter() - t0
+
+    examples_per_sec = BATCH * TIMED_STEPS / elapsed
+    print(json.dumps({
+        "metric": "LeNet-MNIST MultiLayerNetwork.fit examples/sec/chip",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(examples_per_sec / NOMINAL_BASELINE, 3),
+    }))
+    print(f"# platform={platform} dtype={dtype} batch={BATCH} "
+          f"steps={TIMED_STEPS} elapsed={elapsed:.2f}s final_loss={float(loss):.4f}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

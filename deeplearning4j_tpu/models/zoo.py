@@ -1,0 +1,97 @@
+"""Model zoo: the reference benchmark configurations (BASELINE.md).
+
+These are the workloads the reference is measured on: LeNet-MNIST, MLP-Iris,
+AlexNet-CIFAR10, GravesLSTM char-RNN. Built through the same public config
+DSL a user would use.
+"""
+from __future__ import annotations
+
+from ..nn.conf.config import MultiLayerConfiguration, NeuralNetConfiguration
+from ..nn.conf.inputs import InputType
+from ..nn.conf.layers import (BatchNormalization, ConvolutionLayer, DenseLayer,
+                              GravesLSTM, LocalResponseNormalization,
+                              OutputLayer, RnnOutputLayer, SubsamplingLayer)
+from ..nn.updater.updaters import Adam, Nesterovs, Sgd
+
+
+def lenet_mnist(seed: int = 123, lr: float = 0.01, dtype: str = "float32",
+                height: int = 28, width: int = 28, channels: int = 1,
+                n_classes: int = 10) -> MultiLayerConfiguration:
+    """LeNet (BASELINE.md 'LeNet MNIST': Conv/Subsampling/Dense/Output, SGD)."""
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(Nesterovs(momentum=0.9))
+            .regularization(True).l2(5e-4).dtype(dtype)
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="identity", weight_init="xavier"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=n_classes, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(height, width, channels))
+            .build())
+
+
+def mlp_iris(seed: int = 12345, lr: float = 0.1) -> MultiLayerConfiguration:
+    """BASELINE.md 'MLP Iris': DenseLayer + OutputLayer, SGD."""
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(Sgd())
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+
+
+def alexnet_cifar10(seed: int = 42, lr: float = 1e-3, dtype: str = "float32",
+                    n_classes: int = 10) -> MultiLayerConfiguration:
+    """Scaled-down AlexNet for 32x32 CIFAR-10
+    (BASELINE.md 'AlexNet CIFAR-10': Conv + BatchNormalization, Adam)."""
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(Adam())
+            .regularization(True).l2(1e-4).dtype(dtype)
+            .list()
+            .layer(ConvolutionLayer(n_out=64, kernel_size=(3, 3), stride=(1, 1),
+                                    padding=(1, 1), activation="identity"))
+            .layer(BatchNormalization(activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=128, kernel_size=(3, 3), padding=(1, 1),
+                                    activation="identity"))
+            .layer(BatchNormalization(activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3), padding=(1, 1),
+                                    activation="identity"))
+            .layer(BatchNormalization(activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=512, activation="relu", dropout=0.5))
+            .layer(OutputLayer(n_out=n_classes, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(32, 32, 3))
+            .build())
+
+
+def char_rnn_lstm(vocab_size: int = 77, hidden: int = 256, seed: int = 12345,
+                  lr: float = 0.1, tbptt: int = 50,
+                  dtype: str = "float32") -> MultiLayerConfiguration:
+    """GravesLSTM char-RNN with truncated BPTT
+    (BASELINE.md 'GravesLSTM char-RNN', Nesterovs updater)."""
+    from ..nn.conf.config import BACKPROP_TBPTT
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(Nesterovs(momentum=0.9))
+            .dtype(dtype)
+            .list()
+            .layer(GravesLSTM(n_in=vocab_size, n_out=hidden, activation="tanh"))
+            .layer(GravesLSTM(n_in=hidden, n_out=hidden, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=hidden, n_out=vocab_size,
+                                  activation="softmax", loss="mcxent"))
+            .backprop_type(BACKPROP_TBPTT)
+            .t_bptt_forward_length(tbptt).t_bptt_backward_length(tbptt)
+            .build())

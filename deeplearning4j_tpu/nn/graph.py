@@ -1,0 +1,421 @@
+"""ComputationGraph: DAG network runtime.
+
+Parity with the reference deeplearning4j-core/.../nn/graph/ComputationGraph.java
+(1,863 LoC): topologicalOrder:91, init/params-view :235-325,
+fit(DataSetIterator):565, fit(MultiDataSetIterator):627, backprop:960,
+rnnTimeStep:1460; vertex impls under nn/graph/vertex/impl/* (Input/Layer/
+ElementWise/Merge/Subset/Preprocessor + rnn LastTimeStep/DuplicateToTimeSeries).
+
+TPU-first: like MultiLayerNetwork, the whole fit step — topo-ordered forward
+over the DAG, multi-output loss, jax.grad backward, updaters — is ONE
+jit-compiled pure function; vertices are pure ops, the backward pass through
+merge/elementwise/subset vertices is autodiff.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .conf.graph import (ComputationGraphConfiguration,
+                         DuplicateToTimeSeriesVertex, ElementWiseVertex,
+                         GraphVertex, LastTimeStepVertex, LayerVertex,
+                         MergeVertex, PreprocessorVertex, ScaleVertex,
+                         SubsetVertex)
+from .conf.layers import OutputLayer, RnnOutputLayer, LossLayer
+from .layers.base import LayerImpl, impl_for
+from .layers.recurrent import BaseRecurrentImpl
+from .multilayer import _dtype_of
+from .updater.gradnorm import apply_gradient_normalization
+from .updater.schedules import effective_lr
+from ..ops import losses as losses_mod
+
+Array = jax.Array
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.topo = conf.topological_order()
+        self._impls: Dict[str, LayerImpl] = {}
+        for name, v in conf.vertices.items():
+            if isinstance(v, LayerVertex):
+                self._impls[name] = impl_for(v.layer)
+        self.params: Dict[str, Dict[str, Array]] = {}
+        self.variables: Dict[str, Dict[str, Array]] = {}
+        self.updater_state: Dict[str, Dict[str, Dict[str, Array]]] = {}
+        self.step = 0
+        self.score_ = float("nan")
+        self.listeners: List[Any] = []
+        self._rnn_state: Dict[str, Any] = {}
+        self._jit_cache: Dict[Any, Any] = {}
+        self._key = jax.random.PRNGKey(conf.conf.seed)
+        self._initialized = False
+
+    # -- init ------------------------------------------------------------------
+    def init(self) -> "ComputationGraph":
+        dtype = _dtype_of(self.conf.conf)
+        key = jax.random.PRNGKey(self.conf.conf.seed)
+        names = sorted(self._impls)
+        keys = jax.random.split(key, max(len(names), 1))
+        for i, name in enumerate(names):
+            impl = self._impls[name]
+            self.params[name] = impl.init_params(keys[i], dtype)
+            self.variables[name] = impl.init_variables(dtype)
+            layer_conf = self.conf.vertices[name].layer
+            self.updater_state[name] = {
+                pname: layer_conf.updater.init_state(p)
+                for pname, p in self.params[name].items()}
+        self._initialized = True
+        return self
+
+    def _check_init(self):
+        if not self._initialized:
+            self.init()
+
+    # -- vertex forward --------------------------------------------------------
+    def _vertex_forward(self, name: str, vertex: GraphVertex,
+                        inputs: List[Array], params, variables, *,
+                        train, rng, fmasks, states, new_states):
+        if isinstance(vertex, LayerVertex):
+            x = inputs[0]
+            if vertex.preprocessor is not None:
+                x = vertex.preprocessor.preprocess(x)
+            impl = self._impls[name]
+            mask = None  # per-vertex feature masks: use first input's mask
+            if isinstance(impl, BaseRecurrentImpl):
+                state0 = (states or {}).get(name)
+                y, st = impl.forward_with_state(params[name], x, state0,
+                                                train=train, rng=rng, mask=mask)
+                new_states[name] = st
+                return y, variables.get(name, {})
+            y, nv = impl.forward(params[name], x, train=train, rng=rng,
+                                 variables=variables.get(name, {}), mask=mask)
+            return y, nv
+        if isinstance(vertex, MergeVertex):
+            return jnp.concatenate(inputs, axis=-1), None
+        if isinstance(vertex, ElementWiseVertex):
+            op = vertex.op.lower()
+            out = inputs[0]
+            if op == "add":
+                for a in inputs[1:]:
+                    out = out + a
+            elif op == "subtract":
+                for a in inputs[1:]:
+                    out = out - a
+            elif op in ("product", "multiply"):
+                for a in inputs[1:]:
+                    out = out * a
+            elif op in ("average", "avg"):
+                out = sum(inputs) / float(len(inputs))
+            elif op == "max":
+                for a in inputs[1:]:
+                    out = jnp.maximum(out, a)
+            else:
+                raise ValueError(f"Unknown elementwise op '{vertex.op}'")
+            return out, None
+        if isinstance(vertex, SubsetVertex):
+            return inputs[0][..., vertex.from_idx:vertex.to_idx + 1], None
+        if isinstance(vertex, PreprocessorVertex):
+            return vertex.preprocessor.preprocess(inputs[0]), None
+        if isinstance(vertex, ScaleVertex):
+            return inputs[0] * vertex.scale_factor, None
+        if isinstance(vertex, LastTimeStepVertex):
+            x = inputs[0]
+            mask = (fmasks or {}).get(vertex.mask_input)
+            if mask is None:
+                return x[:, -1, :], None
+            idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+            return x[jnp.arange(x.shape[0]), idx, :], None
+        if isinstance(vertex, DuplicateToTimeSeriesVertex):
+            x = inputs[0]
+            ref = vertex.reference_input
+            t = self._current_timesteps.get(ref)
+            if t is None:
+                raise ValueError(f"DuplicateToTimeSeries: unknown reference input {ref}")
+            return jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[-1])), None
+        raise ValueError(f"Unknown vertex type {type(vertex).__name__}")
+
+    def _forward_impl(self, params, variables, inputs: Sequence[Array], *,
+                      train, rng, fmasks=None, states=None):
+        """Topo-ordered DAG forward. Returns (dict name->activation,
+        new variables, new rnn states)."""
+        conf = self.conf
+        dtype = _dtype_of(conf.conf)
+        acts: Dict[str, Array] = {}
+        self._current_timesteps = {}
+        for i, iname in enumerate(conf.network_inputs):
+            x = inputs[i]
+            if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dtype:
+                x = x.astype(dtype)
+            acts[iname] = x
+            if x.ndim == 3:
+                self._current_timesteps[iname] = x.shape[1]
+        new_vars = dict(variables)
+        new_states: Dict[str, Any] = {}
+        n_layer = max(len(self._impls), 1)
+        rngs = (list(jax.random.split(rng, n_layer)) if rng is not None
+                else [None] * n_layer)
+        layer_rng = {name: rngs[i] for i, name in enumerate(sorted(self._impls))}
+        for name in self.topo:
+            vertex = conf.vertices[name]
+            vin = [acts[src] for src in conf.vertex_inputs[name]]
+            y, nv = self._vertex_forward(
+                name, vertex, vin, params, variables,
+                train=train, rng=layer_rng.get(name), fmasks=fmasks,
+                states=states, new_states=new_states)
+            if nv is not None:
+                new_vars[name] = nv
+            acts[name] = y
+            if y.ndim == 3:
+                self._current_timesteps[name] = y.shape[1]
+        return acts, new_vars, new_states
+
+    # -- loss ------------------------------------------------------------------
+    def _loss(self, acts: Dict[str, Array], labels: Sequence[Array],
+              lmasks: Optional[Sequence[Optional[Array]]] = None):
+        total = jnp.asarray(0.0, jnp.float32)
+        for i, out_name in enumerate(self.conf.network_outputs):
+            layer_conf = self.conf.vertices[out_name].layer \
+                if isinstance(self.conf.vertices[out_name], LayerVertex) else None
+            loss_name = getattr(layer_conf, "loss", None) or "mse"
+            loss_fn = losses_mod.get(loss_name)
+            out = acts[out_name]
+            y = labels[i]
+            m = lmasks[i] if lmasks else None
+            if out.ndim == 3:
+                o = out.reshape(-1, out.shape[-1])
+                t = y.reshape(-1, y.shape[-1])
+                mm = m.reshape(-1) if m is not None else None
+                total = total + loss_fn(t, o, mm).astype(jnp.float32)
+            else:
+                total = total + loss_fn(y, out,
+                                        m.reshape(-1) if m is not None else None
+                                        ).astype(jnp.float32)
+        return total
+
+    def _reg_loss(self, params):
+        total = jnp.asarray(0.0, jnp.float32)
+        for name, impl in self._impls.items():
+            total = total + impl.reg_loss(params[name]).astype(jnp.float32)
+        return total
+
+    # -- train step ------------------------------------------------------------
+    def _apply_updaters(self, params, grads, ustates, step):
+        gconf = self.conf.conf
+        new_params, new_ustates = {}, {}
+        for name in params:
+            layer_conf = self.conf.vertices[name].layer
+            lgrads = grads[name]
+            if not lgrads:
+                new_params[name] = params[name]
+                new_ustates[name] = ustates[name]
+                continue
+            lgrads = apply_gradient_normalization(
+                lgrads, layer_conf.gradient_normalization or "none",
+                layer_conf.gradient_normalization_threshold or 1.0)
+            updater = layer_conf.updater
+            base_lr = getattr(updater, "learning_rate", -1.0)
+            if base_lr is None or base_lr < 0:
+                base_lr = layer_conf.learning_rate
+            bias_lr = layer_conf.bias_learning_rate or base_lr
+            lp, lu = {}, {}
+            for pname, g in lgrads.items():
+                lr0 = bias_lr if pname in ("b", "vb", "beta") else base_lr
+                lr = effective_lr(lr0, step, gconf.lr_policy,
+                                  gconf.lr_policy_decay_rate, gconf.lr_policy_power,
+                                  gconf.lr_policy_steps, gconf.max_num_iterations,
+                                  gconf.lr_schedule).astype(g.dtype)
+                delta, ns = updater.apply(ustates[name][pname], g, lr, step)
+                lp[pname] = params[name][pname] + delta
+                lu[pname] = ns
+            new_params[name] = lp
+            new_ustates[name] = lu
+        return new_params, new_ustates
+
+    def _get_train_step(self, key):
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        n_in, n_out, has_fmasks, has_lmasks = key
+
+        def loss_fn(params, variables, inputs, labels, fmasks, lmasks, rng):
+            acts, new_vars, _ = self._forward_impl(params, variables, inputs,
+                                                   train=True, rng=rng,
+                                                   fmasks=fmasks)
+            loss = self._loss(acts, labels, lmasks) + self._reg_loss(params)
+            return loss, new_vars
+
+        def train_step(params, variables, ustates, step, rng, inputs, labels,
+                       fmasks, lmasks):
+            (loss, new_vars), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, variables, inputs, labels, fmasks, lmasks, rng)
+            new_params, new_ustates = self._apply_updaters(params, grads, ustates, step)
+            return new_params, new_vars, new_ustates, loss
+
+        fn = jax.jit(train_step, donate_argnums=(0, 2))
+        self._jit_cache[key] = fn
+        return fn
+
+    # -- fit -------------------------------------------------------------------
+    def fit(self, data, labels=None):
+        """fit(MultiDataSet | DataSet | iterator | (inputs, labels))."""
+        self._check_init()
+        if labels is not None:
+            ins = data if isinstance(data, (list, tuple)) else [data]
+            labs = labels if isinstance(labels, (list, tuple)) else [labels]
+            self._fit_one(ins, labs, None, None)
+            return self
+        if hasattr(data, "features"):
+            self._fit_single_ds(data)
+            return self
+        for ds in data:
+            self._fit_single_ds(ds)
+        return self
+
+    def _fit_single_ds(self, ds):
+        if hasattr(ds, "features_masks"):  # MultiDataSet
+            self._fit_one(ds.features, ds.labels, ds.features_masks, ds.labels_masks)
+        else:
+            fm = getattr(ds, "features_mask", None)
+            lm = getattr(ds, "labels_mask", None)
+            self._fit_one([ds.features], [ds.labels],
+                          [fm] if fm is not None else None,
+                          [lm] if lm is not None else None)
+
+    def _fit_one(self, inputs, labels, fmasks, lmasks):
+        inputs = [jnp.asarray(a) for a in inputs]
+        labels = [jnp.asarray(a) for a in labels]
+        fmasks_d = (dict(zip(self.conf.network_inputs,
+                             [jnp.asarray(m) if m is not None else None
+                              for m in fmasks])) if fmasks else None)
+        lmasks_l = ([jnp.asarray(m) if m is not None else None for m in lmasks]
+                    if lmasks else None)
+        step_fn = self._get_train_step((len(inputs), len(labels),
+                                        fmasks is not None, lmasks is not None))
+        for _ in range(max(1, self.conf.conf.iterations)):
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.variables, self.updater_state,
+             loss) = step_fn(self.params, self.variables, self.updater_state,
+                             jnp.asarray(self.step), sub, inputs, labels,
+                             fmasks_d, lmasks_l)
+            self.score_ = float(loss)
+            self.step += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.step)
+
+    # -- inference -------------------------------------------------------------
+    def output(self, *inputs, train: bool = False) -> List[Array]:
+        self._check_init()
+        ins = [jnp.asarray(a) for a in inputs]
+        acts, _, _ = self._forward_impl(self.params, self.variables, ins,
+                                        train=train, rng=None)
+        return [acts[name] for name in self.conf.network_outputs]
+
+    def output_single(self, *inputs) -> Array:
+        return self.output(*inputs)[0]
+
+    def feed_forward(self, *inputs, train: bool = False) -> Dict[str, Array]:
+        self._check_init()
+        ins = [jnp.asarray(a) for a in inputs]
+        acts, _, _ = self._forward_impl(self.params, self.variables, ins,
+                                        train=train, rng=None)
+        return acts
+
+    def score(self, ds=None, inputs=None, labels=None) -> float:
+        self._check_init()
+        if ds is not None:
+            if hasattr(ds, "features_masks"):
+                inputs, labels = ds.features, ds.labels
+            else:
+                inputs, labels = [ds.features], [ds.labels]
+        inputs = [jnp.asarray(a) for a in inputs]
+        labels = [jnp.asarray(a) for a in labels]
+        acts, _, _ = self._forward_impl(self.params, self.variables, inputs,
+                                        train=False, rng=None)
+        return float(self._loss(acts, labels) + self._reg_loss(self.params))
+
+    def rnn_time_step(self, *inputs) -> List[Array]:
+        """Stateful streaming inference (reference rnnTimeStep:1460)."""
+        self._check_init()
+        ins = []
+        for a in inputs:
+            a = jnp.asarray(a)
+            if a.ndim == 2:
+                a = a[:, None, :]
+            ins.append(a)
+        acts, _, new_states = self._forward_impl(
+            self.params, self.variables, ins, train=False, rng=None,
+            states=self._rnn_state or None)
+        self._rnn_state = new_states
+        return [acts[name] for name in self.conf.network_outputs]
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = {}
+
+    # -- params ----------------------------------------------------------------
+    def num_params(self) -> int:
+        return int(sum(int(np.prod(p.shape))
+                       for lp in self.params.values() for p in lp.values()))
+
+    def params_flat(self) -> np.ndarray:
+        chunks = []
+        for name in sorted(self.params):
+            for pname in sorted(self.params[name]):
+                chunks.append(np.asarray(self.params[name][pname]).reshape(-1))
+        return np.concatenate(chunks) if chunks else np.zeros(0, np.float32)
+
+    def set_params_flat(self, flat: np.ndarray):
+        flat = np.asarray(flat)
+        off = 0
+        for name in sorted(self.params):
+            for pname in sorted(self.params[name]):
+                arr = self.params[name][pname]
+                n = int(np.prod(arr.shape))
+                self.params[name][pname] = jnp.asarray(
+                    flat[off:off + n].reshape(arr.shape), arr.dtype)
+                off += n
+
+    def updater_state_flat(self) -> np.ndarray:
+        chunks = []
+        for name in sorted(self.updater_state):
+            for pname in sorted(self.updater_state[name]):
+                for sname in sorted(self.updater_state[name][pname]):
+                    chunks.append(np.asarray(
+                        self.updater_state[name][pname][sname]).reshape(-1))
+        return np.concatenate(chunks) if chunks else np.zeros(0, np.float32)
+
+    def set_updater_state_flat(self, flat: np.ndarray):
+        flat = np.asarray(flat)
+        off = 0
+        for name in sorted(self.updater_state):
+            for pname in sorted(self.updater_state[name]):
+                for sname in sorted(self.updater_state[name][pname]):
+                    arr = self.updater_state[name][pname][sname]
+                    n = int(np.prod(arr.shape))
+                    self.updater_state[name][pname][sname] = jnp.asarray(
+                        flat[off:off + n].reshape(arr.shape), arr.dtype)
+                    off += n
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+
+    def evaluate(self, iterator):
+        from ..evaluation.evaluation import Evaluation
+        ev = Evaluation()
+        for ds in iterator:
+            out = self.output_single(ds.features)
+            ev.eval(ds.labels, out, mask=getattr(ds, "labels_mask", None))
+        return ev
+
+    def clone(self) -> "ComputationGraph":
+        g = ComputationGraph(copy.deepcopy(self.conf))
+        if self._initialized:
+            g.init()
+            g.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            g.variables = jax.tree_util.tree_map(lambda a: a, self.variables)
+            g.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+            g.step = self.step
+        return g

@@ -94,6 +94,9 @@ class MultiLayerNetwork:
         new_vars = list(variables)
         new_states: Dict[int, Any] = {}
         cur = x
+        dtype = _dtype_of(conf.conf)
+        if jnp.issubdtype(cur.dtype, jnp.floating) and cur.dtype != dtype:
+            cur = cur.astype(dtype)  # cast input to the net's compute dtype
         for i in range(n):
             proc = conf.preprocessor(i)
             if proc is not None:
@@ -168,9 +171,9 @@ class MultiLayerNetwork:
             new_ustates.append(lu)
         return new_params, new_ustates
 
-    def _get_train_step(self, key):
-        if key in self._jit_cache:
-            return self._jit_cache[key]
+    def _build_train_step(self, key):
+        """Build the raw (unjitted) pure train step — reused by the
+        distributed trainers (parallel/) inside shard_map."""
         has_fmask, has_lmask, carry_state = key
 
         def loss_fn(params, variables, x, y, fmask, lmask, rng, states):
@@ -187,7 +190,12 @@ class MultiLayerNetwork:
             new_params, new_ustates = self._apply_updaters(params, grads, ustates, step)
             return new_params, new_vars, new_ustates, loss, new_states
 
-        fn = jax.jit(train_step, donate_argnums=(0, 2))
+        return train_step
+
+    def _get_train_step(self, key):
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        fn = jax.jit(self._build_train_step(key), donate_argnums=(0, 2))
         self._jit_cache[key] = fn
         return fn
 

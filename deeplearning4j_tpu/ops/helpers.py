@@ -41,14 +41,16 @@ _DIMNUMS = ("NHWC", "HWIO", "NHWC")
 
 
 def _conv2d_default(x: Array, w: Array, *, stride, padding, dilation=(1, 1)) -> Array:
+    # bf16 inputs: the TPU MXU accumulates partial sums in f32 internally;
+    # forcing preferred_element_type=f32 here breaks the autodiff transpose
+    # (mixed-dtype conv in the backward pass), so dtypes are left as-is.
     return lax.conv_general_dilated(
         x, w,
         window_strides=tuple(stride),
         padding=padding,
         rhs_dilation=tuple(dilation),
         dimension_numbers=_DIMNUMS,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-    ).astype(x.dtype)
+    )
 
 
 def conv2d(x: Array, w: Array, *, stride=(1, 1), padding="SAME", dilation=(1, 1)) -> Array:

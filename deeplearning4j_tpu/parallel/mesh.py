@@ -1,0 +1,61 @@
+"""Device mesh construction helpers.
+
+The TPU-native replacement for the reference's cluster topology plumbing
+(Spark executor placement / Akka cluster membership, SURVEY.md §2.4): a
+`jax.sharding.Mesh` over ICI-connected devices with named axes. Axis naming
+convention used across the framework:
+  - "data"  : data parallelism (batch sharding; the ParameterAveraging axis)
+  - "model" : tensor parallelism (weight sharding)
+  - "seq"   : sequence/context parallelism (ring attention)
+  - "pipe"  : pipeline stages
+  - "expert": expert parallelism
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+def default_mesh(n_devices: Optional[int] = None, axis: str = DATA_AXIS) -> Mesh:
+    """1-D mesh over the first n local devices."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.asarray(devs[:n]), (axis,))
+
+
+def mesh_2d(data: int, model: int,
+            axes: Tuple[str, str] = (DATA_AXIS, MODEL_AXIS)) -> Mesh:
+    devs = jax.devices()
+    if data * model > len(devs):
+        raise ValueError(f"mesh {data}x{model} needs {data*model} devices, "
+                         f"have {len(devs)}")
+    grid = np.asarray(devs[:data * model]).reshape(data, model)
+    return Mesh(grid, axes)
+
+
+def make_mesh(shape: dict) -> Mesh:
+    """Build a mesh from {axis_name: size}; sizes must multiply to <= #devices."""
+    sizes = [int(s) for s in shape.values()]
+    total = int(np.prod(sizes))
+    devs = jax.devices()
+    if total > len(devs):
+        raise ValueError(f"mesh {shape} needs {total} devices, have {len(devs)}")
+    grid = np.asarray(devs[:total]).reshape(sizes)
+    return Mesh(grid, tuple(shape.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

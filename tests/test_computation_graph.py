@@ -1,0 +1,192 @@
+"""ComputationGraph tests.
+
+Mirrors the reference nn/graph suite (TestComputationGraphNetwork,
+ComputationGraphTestRNN, GradientCheckTestsComputationGraph): topo sort,
+multi-input/multi-output, vertex ops, equivalence with MultiLayerNetwork,
+graph gradient checks, serde.
+"""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, MultiLayerNetwork, NeuralNetConfiguration,
+                               Sgd)
+from deeplearning4j_tpu.nn.conf.graph import (ComputationGraphConfiguration,
+                                              ElementWiseVertex,
+                                              LastTimeStepVertex, MergeVertex,
+                                              SubsetVertex)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, GravesLSTM,
+                                               OutputLayer, RnnOutputLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+
+
+def _simple_graph(seed=12345, lr=0.1):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(Sgd())
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_in=4, n_out=10, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=10, n_out=3, activation="softmax",
+                                          loss="negativeloglikelihood"), "dense")
+            .set_outputs("out")
+            .build())
+
+
+def test_graph_equals_multilayer():
+    """A linear graph must match the equivalent MultiLayerNetwork exactly
+    (reference TestComputationGraphNetwork.testConfigurationBasic)."""
+    ds = load_iris_dataset()
+    g = ComputationGraph(_simple_graph()).init()
+    mln_conf = (NeuralNetConfiguration.builder()
+                .seed(12345).learning_rate(0.1).updater(Sgd())
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=10, activation="tanh"))
+                .layer(OutputLayer(n_in=10, n_out=3, activation="softmax",
+                                   loss="negativeloglikelihood"))
+                .build())
+    mln = MultiLayerNetwork(mln_conf).init()
+    # align initial params (different init orders) then compare training
+    mln.set_params_flat(g.params_flat())
+    for _ in range(5):
+        g.fit(ds.features, ds.labels)
+        mln.fit(ds.features, ds.labels)
+    np.testing.assert_allclose(g.params_flat(), mln.params_flat(),
+                               rtol=1e-5, atol=1e-6)
+    out_g = np.asarray(g.output_single(ds.features[:8]))
+    out_m = np.asarray(mln.output(ds.features[:8]))
+    np.testing.assert_allclose(out_g, out_m, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_input_merge():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_in=3, n_out=4, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_in=2, n_out=4, activation="tanh"), "b")
+            .add_vertex("merge", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                          loss="negativeloglikelihood"), "merge")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(5, 3)).astype(np.float32)
+    b = rng.normal(size=(5, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 5)]
+    out = np.asarray(g.output_single(a, b))
+    assert out.shape == (5, 2)
+    mds = MultiDataSet([a, b], [y])
+    s0 = g.score(mds)
+    for _ in range(20):
+        g.fit(mds)
+    assert g.score(mds) < s0
+
+
+def test_elementwise_and_subset_vertices():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(2).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_in=4, n_out=6, activation="relu"), "in")
+            .add_layer("d2", DenseLayer(n_in=4, n_out=6, activation="relu"), "in")
+            .add_vertex("sum", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_vertex("subset", SubsetVertex(from_idx=0, to_idx=3), "sum")
+            .add_layer("out", OutputLayer(n_in=4, n_out=3, activation="softmax",
+                                          loss="negativeloglikelihood"), "subset")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    x = np.random.default_rng(1).normal(size=(6, 4)).astype(np.float32)
+    acts = g.feed_forward(x)
+    np.testing.assert_allclose(np.asarray(acts["sum"]),
+                               np.asarray(acts["d1"]) + np.asarray(acts["d2"]),
+                               rtol=1e-5)
+    assert acts["subset"].shape == (6, 4)
+    assert acts["out"].shape == (6, 3)
+
+
+def test_multi_output_training():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.05).updater(Adam())
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("trunk", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+            .add_layer("out1", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                           loss="negativeloglikelihood"), "trunk")
+            .add_layer("out2", OutputLayer(n_in=8, n_out=1, activation="identity",
+                                           loss="mse"), "trunk")
+            .set_outputs("out1", "out2")
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    y2 = rng.normal(size=(16, 1)).astype(np.float32)
+    mds = MultiDataSet([x], [y1, y2])
+    s0 = g.score(mds)
+    for _ in range(30):
+        g.fit(mds)
+    assert g.score(mds) < s0
+    outs = g.output(x)
+    assert outs[0].shape == (16, 3) and outs[1].shape == (16, 1)
+
+
+def test_rnn_graph_last_time_step():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).learning_rate(0.05).updater(Adam())
+            .graph_builder()
+            .add_inputs("seq")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=8, activation="tanh"), "seq")
+            .add_vertex("last", LastTimeStepVertex(mask_input="seq"), "lstm")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                          loss="negativeloglikelihood"), "last")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(4, 7, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+    out = np.asarray(g.output_single(x))
+    assert out.shape == (4, 2)
+    s0 = g.score(inputs=[x], labels=[y])
+    for _ in range(20):
+        g.fit(x, y)
+    assert g.score(inputs=[x], labels=[y]) < s0
+
+
+def test_graph_serde_roundtrip():
+    conf = _simple_graph()
+    js = conf.to_json()
+    restored = ComputationGraphConfiguration.from_json(js)
+    assert restored.to_json() == js
+    assert restored.topological_order() == conf.topological_order()
+    g = ComputationGraph(restored).init()
+    assert g.num_params() == 4 * 10 + 10 + 10 * 3 + 3
+
+
+def test_graph_cycle_detection():
+    b = (NeuralNetConfiguration.builder().graph_builder()
+         .add_inputs("in")
+         .add_layer("a", DenseLayer(n_in=4, n_out=4), "in", "b")
+         .add_layer("b", DenseLayer(n_in=4, n_out=4), "a")
+         .set_outputs("b"))
+    with pytest.raises(ValueError, match="cycle"):
+        b.build()
+
+
+def test_graph_checkpoint_roundtrip(tmp_path):
+    from deeplearning4j_tpu.util import model_serializer
+    ds = load_iris_dataset()
+    g = ComputationGraph(_simple_graph()).init()
+    for _ in range(3):
+        g.fit(ds.features, ds.labels)
+    p = tmp_path / "graph.zip"
+    model_serializer.write_model(g, p)
+    restored = model_serializer.restore_computation_graph(p)
+    np.testing.assert_array_equal(g.params_flat(), restored.params_flat())
+    np.testing.assert_allclose(np.asarray(g.output_single(ds.features[:4])),
+                               np.asarray(restored.output_single(ds.features[:4])),
+                               rtol=1e-5)
