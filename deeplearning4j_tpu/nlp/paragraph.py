@@ -1,0 +1,275 @@
+"""ParagraphVectors (doc2vec): PV-DBOW and PV-DM with inference.
+
+Parity with the reference `models/paragraphvectors/ParagraphVectors.java`
+(948 LoC; DBOW/DM via learning/impl/sequence/{DBOW,DM}.java, `inferVector`).
+TPU-first: label (document) vectors live in a separate table; training is the
+same batched negative-sampling machinery as Word2Vec with the document vector
+as (DBOW) or averaged into (DM) the predictor; inferVector runs a few jit
+gradient steps on a fresh row with the word tables frozen.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sentence_iterator import LabelledCollectionSentenceIterator
+from .tokenization import DefaultTokenizerFactory, TokenizerFactory
+from .word2vec import SequenceVectors, _log_sigmoid
+
+
+class ParagraphVectors(SequenceVectors):
+    def __init__(self, layer_size=100, window=5, min_word_frequency=1,
+                 negative=5, learning_rate=0.025, min_learning_rate=1e-4,
+                 epochs=5, batch_size=2048, seed=42, dm=False):
+        super().__init__(layer_size=layer_size, window=window,
+                         min_word_frequency=min_word_frequency,
+                         negative=max(1, negative), learning_rate=learning_rate,
+                         min_learning_rate=min_learning_rate, epochs=epochs,
+                         batch_size=batch_size, seed=seed)
+        self.dm = dm
+        self.label_index: Dict[str, int] = {}
+        self.doc_vectors: Optional[jnp.ndarray] = None
+        self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._sentences: List[str] = []
+            self._labels: List[str] = []
+            self._tokenizer = DefaultTokenizerFactory()
+
+        def __getattr__(self, name):
+            mapping = {"layer_size": "layer_size", "window_size": "window",
+                       "min_word_frequency": "min_word_frequency",
+                       "negative_sample": "negative",
+                       "learning_rate": "learning_rate",
+                       "min_learning_rate": "min_learning_rate",
+                       "epochs": "epochs", "iterations": "epochs",
+                       "batch_size": "batch_size", "seed": "seed",
+                       "dm": "dm"}
+            if name in mapping:
+                def setter(value):
+                    self._kw[mapping[name]] = value
+                    return self
+                return setter
+            raise AttributeError(name)
+
+        def iterate(self, iterator: LabelledCollectionSentenceIterator):
+            self._sentences = list(iterator._sentences)
+            self._labels = list(iterator._labels)
+            return self
+
+        def documents(self, sentences: List[str], labels: List[str]):
+            self._sentences = sentences
+            self._labels = labels
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tokenizer = tf
+            return self
+
+        def build(self) -> "ParagraphVectors":
+            pv = ParagraphVectors(**self._kw)
+            pv._sentences = self._sentences
+            pv._labels = self._labels
+            pv._tokenizer = self._tokenizer
+            return pv
+
+    @staticmethod
+    def builder() -> "ParagraphVectors.Builder":
+        return ParagraphVectors.Builder()
+
+    # -- training --------------------------------------------------------------
+    def _make_doc_step(self):
+        def loss_fn(docvecs, syn1neg, doc, target, negs, valid):
+            h = docvecs[doc]
+            pos = jnp.sum(h * syn1neg[target], -1)
+            neg = jnp.einsum("bd,bkd->bk", h, syn1neg[negs])
+            neg_mask = (negs != target[:, None]).astype(neg.dtype)
+            l = -_log_sigmoid(pos) - jnp.sum(_log_sigmoid(-neg) * neg_mask, -1)
+            return jnp.sum(l * valid)  # sum: see word2vec._make_neg_step
+
+        clip = self.grad_clip
+
+        @jax.jit
+        def step(docvecs, syn1neg, doc, target, negs, valid, lr):
+            loss, (gd, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                docvecs, syn1neg, doc, target, negs, valid)
+            gd = jnp.clip(gd, -clip, clip)
+            g1 = jnp.clip(g1, -clip, clip)
+            return (docvecs - lr * gd, syn1neg - lr * g1,
+                    loss / jnp.maximum(jnp.sum(valid), 1.0))
+
+        return step
+
+    def _make_dm_step(self):
+        """PV-DM: (doc vector + mean of context word vectors) predicts the
+        center word (reference learning/impl/sequence/DM.java)."""
+        clip = self.grad_clip
+
+        def loss_fn(docvecs, syn0, syn1neg, doc, center, ctx, cmask, negs, valid):
+            cnt = jnp.sum(cmask, -1, keepdims=True)
+            h = (docvecs[doc] + jnp.einsum("bwd,bw->bd", syn0[ctx], cmask)) \
+                / jnp.maximum(cnt + 1.0, 1.0)
+            pos = jnp.sum(h * syn1neg[center], -1)
+            neg = jnp.einsum("bd,bkd->bk", h, syn1neg[negs])
+            neg_mask = (negs != center[:, None]).astype(neg.dtype)
+            l = -_log_sigmoid(pos) - jnp.sum(_log_sigmoid(-neg) * neg_mask, -1)
+            return jnp.sum(l * valid)
+
+        @jax.jit
+        def step(docvecs, syn0, syn1neg, doc, center, ctx, cmask, negs, valid, lr):
+            loss, (gd, g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+                docvecs, syn0, syn1neg, doc, center, ctx, cmask, negs, valid)
+            gd = jnp.clip(gd, -clip, clip)
+            g0 = jnp.clip(g0, -clip, clip)
+            g1 = jnp.clip(g1, -clip, clip)
+            return (docvecs - lr * gd, syn0 - lr * g0, syn1neg - lr * g1,
+                    loss / jnp.maximum(jnp.sum(valid), 1.0))
+
+        return step
+
+    def _dm_epoch(self, encoded, rng, step):
+        W = self.window
+        B = self.batch_size
+        docs, centers, ctxs, cmasks = [], [], [], []
+        for seq, lab in zip(encoded, self._labels):
+            di = self.label_index[lab]
+            n = len(seq)
+            for i in range(n):
+                lo, hi = max(0, i - W), min(n, i + W + 1)
+                window = [seq[j] for j in range(lo, hi) if j != i]
+                pad = 2 * W - len(window)
+                docs.append(di)
+                centers.append(seq[i])
+                ctxs.append(window + [0] * pad)
+                cmasks.append([1.0] * len(window) + [0.0] * pad)
+        if not docs:
+            return
+        docs = np.asarray(docs, np.int32)
+        centers = np.asarray(centers, np.int32)
+        ctxs = np.asarray(ctxs, np.int32)
+        cmasks = np.asarray(cmasks, np.float32)
+        perm = rng.permutation(docs.size)
+        docs, centers, ctxs, cmasks = docs[perm], centers[perm], ctxs[perm], cmasks[perm]
+        for off in range(0, docs.size, B):
+            d = docs[off:off + B]
+            c = centers[off:off + B]
+            cx = ctxs[off:off + B]
+            cm = cmasks[off:off + B]
+            nv = d.size
+            if nv < B:
+                d = np.pad(d, (0, B - nv))
+                c = np.pad(c, (0, B - nv))
+                cx = np.pad(cx, ((0, B - nv), (0, 0)))
+                cm = np.pad(cm, ((0, B - nv), (0, 0)))
+            valid = np.zeros(B, np.float32)
+            valid[:nv] = 1.0
+            negs = rng.choice(self.vocab.num_words(), size=(B, self.negative),
+                              p=self._neg_probs).astype(np.int32)
+            (self.doc_vectors, self.lookup_table.syn0,
+             self.lookup_table.syn1neg, loss) = step(
+                self.doc_vectors, self.lookup_table.syn0,
+                self.lookup_table.syn1neg, jnp.asarray(d), jnp.asarray(c),
+                jnp.asarray(cx), jnp.asarray(cm), jnp.asarray(negs),
+                jnp.asarray(valid), np.float32(self.learning_rate))
+
+    def fit(self):
+        sequences = [self._tokenizer.create(s).get_tokens() for s in self._sentences]
+        # word vectors first (DBOW also trains word vectors in reference when
+        # trainWordVectors=true; we always do — it shares syn1neg)
+        self.fit_sequences(sequences)
+        self.label_index = {}
+        for lab in self._labels:
+            if lab not in self.label_index:
+                self.label_index[lab] = len(self.label_index)
+        n_docs = len(self.label_index)
+        rng = np.random.default_rng(self.seed + 1)
+        self.doc_vectors = jnp.asarray(
+            (rng.random((n_docs, self.layer_size), np.float32) - 0.5)
+            / self.layer_size)
+        encoded = self._encode(sequences)
+        if self.dm:
+            step = self._make_dm_step()
+            for _ in range(self.epochs):
+                self._dm_epoch(encoded, rng, step)
+            return self
+        step = self._make_doc_step()
+        B = self.batch_size
+        for _ in range(self.epochs):
+            docs, targets = [], []
+            for seq, lab in zip(encoded, self._labels):
+                di = self.label_index[lab]
+                for widx in seq:
+                    docs.append(di)
+                    targets.append(widx)
+            docs = np.asarray(docs, np.int32)
+            targets = np.asarray(targets, np.int32)
+            perm = rng.permutation(docs.size)
+            docs, targets = docs[perm], targets[perm]
+            for off in range(0, docs.size, B):
+                d = docs[off:off + B]
+                t = targets[off:off + B]
+                nv = d.size
+                if nv < B:
+                    d = np.pad(d, (0, B - nv))
+                    t = np.pad(t, (0, B - nv))
+                valid = np.zeros(B, np.float32)
+                valid[:nv] = 1.0
+                negs = rng.choice(self.vocab.num_words(),
+                                  size=(B, self.negative),
+                                  p=self._neg_probs).astype(np.int32)
+                self.doc_vectors, self.lookup_table.syn1neg, loss = step(
+                    self.doc_vectors, self.lookup_table.syn1neg,
+                    jnp.asarray(d), jnp.asarray(t), jnp.asarray(negs),
+                    jnp.asarray(valid), np.float32(self.learning_rate))
+        return self
+
+    # -- query -----------------------------------------------------------------
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        idx = self.label_index.get(label)
+        return None if idx is None else np.asarray(self.doc_vectors[idx])
+
+    def infer_vector(self, text: str, steps: int = 20,
+                     lr: float = 0.05) -> np.ndarray:
+        """Gradient-infer a vector for unseen text (reference inferVector)."""
+        tokens = self._tokenizer.create(text).get_tokens()
+        idx = np.asarray([self.vocab.index_of(t) for t in tokens
+                          if self.vocab.index_of(t) >= 0], np.int32)
+        rng = np.random.default_rng(abs(hash(text)) % (2**31))
+        vec = jnp.asarray((rng.random(self.layer_size, np.float32) - 0.5)
+                          / self.layer_size)
+        if idx.size == 0:
+            return np.asarray(vec)
+        syn1neg = self.lookup_table.syn1neg
+
+        def loss_fn(v, targets, negs):
+            pos = syn1neg[targets] @ v
+            neg = jnp.einsum("kd,d->k", syn1neg[negs], v)
+            neg_mask = (~jnp.isin(negs, targets)).astype(neg.dtype)
+            return -jnp.sum(_log_sigmoid(pos)) - jnp.sum(_log_sigmoid(-neg) * neg_mask)
+
+        grad_fn = jax.jit(jax.grad(loss_fn))
+        for _ in range(steps):
+            negs = rng.choice(self.vocab.num_words(), size=(self.negative,),
+                              p=self._neg_probs).astype(np.int32)
+            vec = vec - lr * grad_fn(vec, jnp.asarray(idx), jnp.asarray(negs))
+        return np.asarray(vec)
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        v = self.infer_vector(text)
+        d = self.doc_vector(label)
+        denom = np.linalg.norm(v) * np.linalg.norm(d)
+        return float(v @ d / denom) if denom else 0.0
+
+    def nearest_labels(self, text: str, n: int = 5) -> List[str]:
+        v = self.infer_vector(text)
+        dv = np.asarray(self.doc_vectors)
+        sims = dv @ v / (np.linalg.norm(dv, axis=1) * (np.linalg.norm(v) + 1e-12)
+                         + 1e-12)
+        order = np.argsort(-sims)
+        inv = {i: l for l, i in self.label_index.items()}
+        return [inv[int(i)] for i in order[:n]]

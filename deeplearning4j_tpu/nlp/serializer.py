@@ -1,0 +1,96 @@
+"""Word-vector serialization in word2vec-compatible text/binary formats.
+
+Parity with the reference `models/embeddings/loader/WordVectorSerializer`
+(writeWordVectors / loadTxtVectors / word2vec C binary format).
+"""
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Tuple
+
+import numpy as np
+
+from .vocab import VocabCache, VocabWord
+from .word2vec import InMemoryLookupTable, SequenceVectors
+
+
+def write_word_vectors(model: SequenceVectors, path) -> None:
+    """word2vec text format: header 'V D', then 'word v1 v2 ...' per line."""
+    path = Path(path)
+    syn0 = np.asarray(model.lookup_table.syn0)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(f"{model.vocab.num_words()} {model.layer_size}\n")
+        for vw in model.vocab.vocab_words():
+            vec = " ".join(f"{x:.6f}" for x in syn0[vw.index])
+            f.write(f"{vw.word} {vec}\n")
+
+
+def load_txt_vectors(path) -> SequenceVectors:
+    """Load word2vec text format into a query-able SequenceVectors."""
+    path = Path(path)
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        header = f.readline().split()
+        v, d = int(header[0]), int(header[1])
+        words, vectors = [], []
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            if len(parts) < d + 1:
+                continue
+            words.append(parts[0])
+            vectors.append(np.asarray(parts[1:d + 1], np.float32))
+    model = SequenceVectors(layer_size=d)
+    cache = VocabCache()
+    for i, w in enumerate(words):
+        vw = VocabWord(w, count=1, index=i)
+        cache._words[w] = vw
+        cache._by_index.append(vw)
+    model.vocab = cache
+    import jax.numpy as jnp
+    model.lookup_table = InMemoryLookupTable(len(words), d, use_hs=False,
+                                             use_neg=False)
+    model.lookup_table.syn0 = jnp.asarray(np.stack(vectors))
+    return model
+
+
+def write_word_vectors_binary(model: SequenceVectors, path) -> None:
+    """word2vec C binary format."""
+    path = Path(path)
+    syn0 = np.asarray(model.lookup_table.syn0, np.float32)
+    with open(path, "wb") as f:
+        f.write(f"{model.vocab.num_words()} {model.layer_size}\n".encode())
+        for vw in model.vocab.vocab_words():
+            f.write(vw.word.encode("utf-8") + b" ")
+            f.write(syn0[vw.index].tobytes())
+            f.write(b"\n")
+
+
+def load_binary_vectors(path) -> SequenceVectors:
+    path = Path(path)
+    with open(path, "rb") as f:
+        header = f.readline().split()
+        v, d = int(header[0]), int(header[1])
+        words, vectors = [], []
+        for _ in range(v):
+            word = bytearray()
+            while True:
+                ch = f.read(1)
+                if ch == b" " or not ch:
+                    break
+                word.extend(ch)
+            vec = np.frombuffer(f.read(4 * d), np.float32)
+            f.read(1)  # trailing newline
+            words.append(word.decode("utf-8", errors="replace"))
+            vectors.append(vec)
+    model = SequenceVectors(layer_size=d)
+    cache = VocabCache()
+    for i, w in enumerate(words):
+        vw = VocabWord(w, count=1, index=i)
+        cache._words[w] = vw
+        cache._by_index.append(vw)
+    model.vocab = cache
+    import jax.numpy as jnp
+    model.lookup_table = InMemoryLookupTable(len(words), d, use_hs=False,
+                                             use_neg=False)
+    model.lookup_table.syn0 = jnp.asarray(np.stack(vectors))
+    return model

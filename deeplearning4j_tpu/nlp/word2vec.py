@@ -1,0 +1,428 @@
+"""SequenceVectors + Word2Vec (SkipGram/CBOW, negative sampling + hierarchic softmax).
+
+Parity with the reference embeddings stack (SURVEY.md §2.5):
+  - `models/sequencevectors/SequenceVectors.java:48` — the generic trainer
+    over SequenceElements (fit():137: vocab build -> training threads)
+  - `models/embeddings/learning/impl/elements/SkipGram.java:24` (HS +
+    negative sampling :223-225), `CBOW.java`
+  - `models/word2vec/Word2Vec.java` builder facade
+  - `models/embeddings/inmemory/InMemoryLookupTable` (syn0/syn1/syn1Neg)
+
+TPU-first redesign (SURVEY.md §7 item 7): the reference trains with HogWild —
+lock-free scatter updates from many threads (VectorCalculationsThread,
+deliberately racy). Scatter races don't map to TPU; instead training pairs are
+generated host-side and processed in large BATCHED jit steps: gather rows,
+compute the sampled-softmax loss, and let autodiff's gather-transpose produce
+scatter-ADD gradients — mathematically the same update, executed dense on the
+MXU, deterministic given the seed. Convergence is validated by similarity
+tests (like the reference's Word2VecTests), not bitwise comparison.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sentence_iterator import CollectionSentenceIterator, SentenceIterator
+from .tokenization import DefaultTokenizerFactory, TokenizerFactory
+from .vocab import VocabCache, VocabConstructor, build_huffman
+
+Array = jax.Array
+
+
+def _log_sigmoid(x):
+    return -jax.nn.softplus(-x)
+
+
+class InMemoryLookupTable:
+    """syn0 / syn1 (HS) / syn1neg weight store
+    (reference InMemoryLookupTable.java:62-74)."""
+
+    def __init__(self, vocab_size: int, layer_size: int, seed: int = 42,
+                 use_hs: bool = False, use_neg: bool = True):
+        self.vocab_size = vocab_size
+        self.layer_size = layer_size
+        rng = np.random.default_rng(seed)
+        self.syn0 = jnp.asarray(
+            (rng.random((vocab_size, layer_size), np.float32) - 0.5) / layer_size)
+        self.syn1 = (jnp.zeros((max(vocab_size - 1, 1), layer_size), jnp.float32)
+                     if use_hs else None)
+        self.syn1neg = (jnp.zeros((vocab_size, layer_size), jnp.float32)
+                        if use_neg else None)
+
+    def vector(self, idx: int) -> np.ndarray:
+        return np.asarray(self.syn0[idx])
+
+
+class SequenceVectors:
+    """Generic embedding trainer over element sequences
+    (reference SequenceVectors.java:48). Subclasses/builders supply sequences
+    of string elements; training is batched SkipGram/CBOW."""
+
+    def __init__(self, layer_size=100, window=5, min_word_frequency=1,
+                 negative=5, use_hierarchic_softmax=False, learning_rate=0.025,
+                 min_learning_rate=1e-4, epochs=1, batch_size=2048, seed=42,
+                 subsample=0.0, cbow=False, grad_clip=1.0):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.subsample = subsample
+        self.cbow = cbow
+        # elementwise clip on the summed batch gradient: bounds the update a
+        # single row can receive when it recurs many times in one batch (the
+        # sequential reference bounds this naturally by updating incrementally)
+        self.grad_clip = grad_clip
+        self.vocab: Optional[VocabCache] = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self._unigram_table: Optional[np.ndarray] = None
+        self._max_code_len = 0
+
+    # -- data ------------------------------------------------------------------
+    def _build_vocab(self, sequences: List[List[str]]):
+        self.vocab = VocabConstructor(self.min_word_frequency).build_vocab(sequences)
+        if self.use_hs:
+            build_huffman(self.vocab)
+            self._max_code_len = max(
+                (len(v.codes) for v in self.vocab.vocab_words()), default=0)
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab.num_words(), self.layer_size, self.seed,
+            use_hs=self.use_hs, use_neg=self.negative > 0)
+        # unigram^0.75 negative-sampling table (reference uses the same
+        # power-law table inside ND4J's word2vec sampling)
+        counts = np.array([v.count for v in self.vocab.vocab_words()], np.float64)
+        probs = counts ** 0.75
+        self._neg_probs = (probs / probs.sum()).astype(np.float64)
+
+    def _encode(self, sequences: List[List[str]]) -> List[np.ndarray]:
+        out = []
+        for seq in sequences:
+            idx = [self.vocab.index_of(w) for w in seq]
+            out.append(np.array([i for i in idx if i >= 0], np.int32))
+        return out
+
+    def _pairs(self, encoded: List[np.ndarray], rng: np.random.Generator
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """(center, context) pairs with word2vec's random reduced window."""
+        centers, contexts = [], []
+        for seq in encoded:
+            n = len(seq)
+            if n < 2:
+                continue
+            b = rng.integers(1, self.window + 1, n)
+            for i in range(n):
+                lo = max(0, i - b[i])
+                hi = min(n, i + b[i] + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(seq[i])
+                        contexts.append(seq[j])
+        return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+
+    # -- jitted steps ----------------------------------------------------------
+    def _make_neg_step(self):
+        K = self.negative
+
+        def loss_fn(syn0, syn1neg, center, context, negs, valid):
+            h = syn0[center]                      # [B, D]
+            pos = jnp.sum(h * syn1neg[context], -1)
+            neg = jnp.einsum("bd,bkd->bk", h, syn1neg[negs])
+            # drop sampled negatives that collide with the positive target
+            # (the reference's sampler skips target==negative draws)
+            neg_mask = (negs != context[:, None]).astype(neg.dtype)
+            l = -_log_sigmoid(pos) - jnp.sum(_log_sigmoid(-neg) * neg_mask, -1)
+            # SUM over the batch: to first order this matches the reference's
+            # sequential per-pair SGD total displacement (HogWild semantics);
+            # a mean-reduced loss would shrink the update by the batch size
+            return jnp.sum(l * valid)
+
+        clip = self.grad_clip
+
+        @jax.jit
+        def step(syn0, syn1neg, center, context, negs, valid, lr):
+            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                syn0, syn1neg, center, context, negs, valid)
+            g0 = jnp.clip(g0, -clip, clip)
+            g1 = jnp.clip(g1, -clip, clip)
+            return (syn0 - lr * g0, syn1neg - lr * g1,
+                    loss / jnp.maximum(jnp.sum(valid), 1.0))
+
+        return step
+
+    def _make_hs_step(self):
+        def loss_fn(syn0, syn1, center, points, codes, code_mask, valid):
+            h = syn0[center]                           # [B, D]
+            logits = jnp.einsum("bd,bpd->bp", h, syn1[points])
+            sign = 1.0 - 2.0 * codes                   # code 0 -> +1, 1 -> -1
+            l = -jnp.sum(_log_sigmoid(sign * logits) * code_mask, -1)
+            return jnp.sum(l * valid)  # sum: see _make_neg_step
+
+        clip = self.grad_clip
+
+        @jax.jit
+        def step(syn0, syn1, center, points, codes, code_mask, valid, lr):
+            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                syn0, syn1, center, points, codes, code_mask, valid)
+            g0 = jnp.clip(g0, -clip, clip)
+            g1 = jnp.clip(g1, -clip, clip)
+            return (syn0 - lr * g0, syn1 - lr * g1,
+                    loss / jnp.maximum(jnp.sum(valid), 1.0))
+
+        return step
+
+    def _subsample(self, encoded: List[np.ndarray],
+                   rng: np.random.Generator) -> List[np.ndarray]:
+        """Frequent-word subsampling: drop token with prob 1 - sqrt(t/f)
+        (word2vec convention; reference `sampling` option)."""
+        if self.subsample <= 0:
+            return encoded
+        counts = np.array([v.count for v in self.vocab.vocab_words()], np.float64)
+        freq = counts / max(self.vocab.total_word_count, 1)
+        keep_prob = np.minimum(1.0, np.sqrt(self.subsample / np.maximum(freq, 1e-12)))
+        out = []
+        for seq in encoded:
+            if seq.size == 0:
+                out.append(seq)
+                continue
+            keep = rng.random(seq.size) < keep_prob[seq]
+            out.append(seq[keep])
+        return out
+
+    def _cbow_batches(self, encoded: List[np.ndarray], rng: np.random.Generator):
+        """(center, context-window [2W] padded, context mask) tuples."""
+        W = self.window
+        centers, ctxs, masks = [], [], []
+        for seq in encoded:
+            n = len(seq)
+            if n < 2:
+                continue
+            b = rng.integers(1, W + 1, n)
+            for i in range(n):
+                lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+                window = [seq[j] for j in range(lo, hi) if j != i]
+                if not window:
+                    continue
+                pad = 2 * W - len(window)
+                centers.append(seq[i])
+                ctxs.append(window + [0] * pad)
+                masks.append([1.0] * len(window) + [0.0] * pad)
+        return (np.asarray(centers, np.int32), np.asarray(ctxs, np.int32),
+                np.asarray(masks, np.float32))
+
+    def _make_cbow_step(self):
+        clip = self.grad_clip
+
+        def loss_fn(syn0, syn1neg, center, ctx, cmask, negs, valid):
+            h = jnp.einsum("bwd,bw->bd", syn0[ctx], cmask) \
+                / jnp.maximum(jnp.sum(cmask, -1, keepdims=True), 1.0)
+            pos = jnp.sum(h * syn1neg[center], -1)
+            neg = jnp.einsum("bd,bkd->bk", h, syn1neg[negs])
+            neg_mask = (negs != center[:, None]).astype(neg.dtype)
+            l = -_log_sigmoid(pos) - jnp.sum(_log_sigmoid(-neg) * neg_mask, -1)
+            return jnp.sum(l * valid)
+
+        @jax.jit
+        def step(syn0, syn1neg, center, ctx, cmask, negs, valid, lr):
+            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                syn0, syn1neg, center, ctx, cmask, negs, valid)
+            g0 = jnp.clip(g0, -clip, clip)
+            g1 = jnp.clip(g1, -clip, clip)
+            return (syn0 - lr * g0, syn1neg - lr * g1,
+                    loss / jnp.maximum(jnp.sum(valid), 1.0))
+
+        return step
+
+    # -- training --------------------------------------------------------------
+    def fit_sequences(self, sequences: List[List[str]]):
+        self._build_vocab(sequences)
+        encoded = self._encode(sequences)
+        rng = np.random.default_rng(self.seed)
+        table = self.lookup_table
+        step_neg = self._make_neg_step() if self.negative > 0 else None
+        step_hs = self._make_hs_step() if self.use_hs else None
+        if self.use_hs:
+            P = max(self._max_code_len, 1)
+            V = self.vocab.num_words()
+            points_tbl = np.zeros((V, P), np.int32)
+            codes_tbl = np.zeros((V, P), np.float32)
+            mask_tbl = np.zeros((V, P), np.float32)
+            for vw in self.vocab.vocab_words():
+                L = len(vw.codes)
+                points_tbl[vw.index, :L] = vw.points
+                codes_tbl[vw.index, :L] = vw.codes
+                mask_tbl[vw.index, :L] = 1.0
+            points_tbl = jnp.asarray(points_tbl)
+            codes_tbl = jnp.asarray(codes_tbl)
+            mask_tbl = jnp.asarray(mask_tbl)
+
+        # total pair estimate for linear lr decay (word2vec convention)
+        total_pairs = max(1, sum(max(len(s) - 1, 0) for s in encoded)
+                          * self.window * self.epochs)
+        if self.cbow and self.negative <= 0:
+            raise ValueError("CBOW requires negative sampling (negative > 0)")
+        step_cbow = self._make_cbow_step() if self.cbow else None
+        seen = 0
+        B = self.batch_size
+        last_loss = float("nan")
+        for _ in range(self.epochs):
+            order = rng.permutation(len(encoded))
+            epoch_seqs = self._subsample([encoded[i] for i in order], rng)
+            if self.cbow:
+                centers, ctxs, cmasks = self._cbow_batches(epoch_seqs, rng)
+                for off in range(0, centers.size, B):
+                    c = centers[off:off + B]
+                    cx = ctxs[off:off + B]
+                    cm = cmasks[off:off + B]
+                    nv = c.size
+                    if nv < B:
+                        c = np.pad(c, (0, B - nv))
+                        cx = np.pad(cx, ((0, B - nv), (0, 0)))
+                        cm = np.pad(cm, ((0, B - nv), (0, 0)))
+                    valid = np.zeros(B, np.float32)
+                    valid[:nv] = 1.0
+                    frac = min(1.0, seen / total_pairs)
+                    lr = np.float32(max(self.min_learning_rate,
+                                        self.learning_rate * (1.0 - frac)))
+                    negs = rng.choice(self.vocab.num_words(),
+                                      size=(B, self.negative), p=self._neg_probs
+                                      ).astype(np.int32)
+                    table.syn0, table.syn1neg, loss = step_cbow(
+                        table.syn0, table.syn1neg, jnp.asarray(c),
+                        jnp.asarray(cx), jnp.asarray(cm), jnp.asarray(negs),
+                        jnp.asarray(valid), lr)
+                    last_loss = float(loss)
+                    seen += nv
+                continue
+            centers, contexts = self._pairs(epoch_seqs, rng)
+            if centers.size == 0:
+                continue
+            perm = rng.permutation(centers.size)
+            centers, contexts = centers[perm], contexts[perm]
+            for off in range(0, centers.size, B):
+                c = centers[off:off + B]
+                t = contexts[off:off + B]
+                nvalid = c.size
+                if nvalid < B:  # pad to static shape
+                    c = np.pad(c, (0, B - nvalid))
+                    t = np.pad(t, (0, B - nvalid))
+                valid = np.zeros(B, np.float32)
+                valid[:nvalid] = 1.0
+                frac = min(1.0, seen / total_pairs)
+                lr = np.float32(max(self.min_learning_rate,
+                                    self.learning_rate * (1.0 - frac)))
+                if self.negative > 0:
+                    negs = rng.choice(self.vocab.num_words(),
+                                      size=(B, self.negative), p=self._neg_probs
+                                      ).astype(np.int32)
+                    table.syn0, table.syn1neg, loss = step_neg(
+                        table.syn0, table.syn1neg, jnp.asarray(c), jnp.asarray(t),
+                        jnp.asarray(negs), jnp.asarray(valid), lr)
+                if self.use_hs:
+                    table.syn0, table.syn1, loss = step_hs(
+                        table.syn0, table.syn1, jnp.asarray(c),
+                        points_tbl[t], codes_tbl[t], mask_tbl[t],
+                        jnp.asarray(valid), lr)
+                last_loss = float(loss)
+                seen += nvalid
+        self.score_ = last_loss
+        return self
+
+    # -- query API (reference wordVectors interface) ---------------------------
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def word_vector(self, word: str) -> Optional[np.ndarray]:
+        if not self.has_word(word):
+            return None
+        return np.asarray(self.lookup_table.syn0[self.vocab.index_of(word)])
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.word_vector(w1), self.word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        denom = np.linalg.norm(a) * np.linalg.norm(b)
+        return float(a @ b / denom) if denom else 0.0
+
+    def words_nearest(self, word_or_vec, n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            vec = self.word_vector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            vec = np.asarray(word_or_vec)
+            exclude = set()
+        if vec is None:
+            return []
+        syn0 = np.asarray(self.lookup_table.syn0)
+        norms = np.linalg.norm(syn0, axis=1) * (np.linalg.norm(vec) + 1e-12)
+        sims = syn0 @ vec / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for idx in order:
+            w = self.vocab.word_at_index(int(idx))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= n:
+                break
+        return out
+
+
+class Word2Vec(SequenceVectors):
+    """Builder facade (reference models/word2vec/Word2Vec.java)."""
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._iterator: Optional[SentenceIterator] = None
+            self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
+
+        def __getattr__(self, name):
+            mapping = {
+                "layer_size": "layer_size", "window_size": "window",
+                "min_word_frequency": "min_word_frequency",
+                "negative_sample": "negative", "learning_rate": "learning_rate",
+                "min_learning_rate": "min_learning_rate", "epochs": "epochs",
+                "iterations": "epochs", "batch_size": "batch_size",
+                "seed": "seed", "sampling": "subsample",
+                "use_hierarchic_softmax": "use_hierarchic_softmax",
+                "cbow": "cbow",
+            }
+            if name in mapping:
+                def setter(value):
+                    self._kw[mapping[name]] = value
+                    return self
+                return setter
+            raise AttributeError(name)
+
+        def iterate(self, iterator):
+            if isinstance(iterator, (list, tuple)):
+                iterator = CollectionSentenceIterator(iterator)
+            self._iterator = iterator
+            return self
+
+        def tokenizer_factory(self, tf: TokenizerFactory):
+            self._tokenizer = tf
+            return self
+
+        def build(self) -> "Word2Vec":
+            w2v = Word2Vec(**self._kw)
+            w2v._iterator = self._iterator
+            w2v._tokenizer = self._tokenizer
+            return w2v
+
+    @staticmethod
+    def builder() -> "Word2Vec.Builder":
+        return Word2Vec.Builder()
+
+    def fit(self):
+        sequences = [self._tokenizer.create(s).get_tokens()
+                     for s in self._iterator]
+        return self.fit_sequences(sequences)

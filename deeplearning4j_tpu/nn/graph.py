@@ -307,9 +307,21 @@ class ComputationGraph:
                 listener.iteration_done(self, self.step)
 
     # -- inference -------------------------------------------------------------
+    def _get_forward(self, n_inputs: int):
+        key = ("fwd", n_inputs)
+        if key not in self._jit_cache:
+            def fwd(params, variables, inputs):
+                acts, _, _ = self._forward_impl(params, variables, inputs,
+                                                train=False, rng=None)
+                return [acts[name] for name in self.conf.network_outputs]
+            self._jit_cache[key] = jax.jit(fwd)
+        return self._jit_cache[key]
+
     def output(self, *inputs, train: bool = False) -> List[Array]:
         self._check_init()
         ins = [jnp.asarray(a) for a in inputs]
+        if not train:
+            return self._get_forward(len(ins))(self.params, self.variables, ins)
         acts, _, _ = self._forward_impl(self.params, self.variables, ins,
                                         train=train, rng=None)
         return [acts[name] for name in self.conf.network_outputs]
@@ -324,18 +336,23 @@ class ComputationGraph:
                                         train=train, rng=None)
         return acts
 
-    def score(self, ds=None, inputs=None, labels=None) -> float:
+    def score(self, ds=None, inputs=None, labels=None, lmasks=None) -> float:
         self._check_init()
         if ds is not None:
             if hasattr(ds, "features_masks"):
                 inputs, labels = ds.features, ds.labels
+                lmasks = ds.labels_masks
             else:
                 inputs, labels = [ds.features], [ds.labels]
+                lm = getattr(ds, "labels_mask", None)
+                lmasks = [lm] if lm is not None else None
         inputs = [jnp.asarray(a) for a in inputs]
         labels = [jnp.asarray(a) for a in labels]
+        if lmasks is not None:
+            lmasks = [jnp.asarray(m) if m is not None else None for m in lmasks]
         acts, _, _ = self._forward_impl(self.params, self.variables, inputs,
                                         train=False, rng=None)
-        return float(self._loss(acts, labels) + self._reg_loss(self.params))
+        return float(self._loss(acts, labels, lmasks) + self._reg_loss(self.params))
 
     def rnn_time_step(self, *inputs) -> List[Array]:
         """Stateful streaming inference (reference rnnTimeStep:1460)."""
@@ -414,8 +431,10 @@ class ComputationGraph:
         g = ComputationGraph(copy.deepcopy(self.conf))
         if self._initialized:
             g.init()
-            g.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            g.variables = jax.tree_util.tree_map(lambda a: a, self.variables)
-            g.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+            # deep-copy buffers: the jitted train step donates params/updater
+            # state, which would invalidate shared arrays on TPU
+            g.params = jax.tree_util.tree_map(jnp.array, self.params)
+            g.variables = jax.tree_util.tree_map(jnp.array, self.variables)
+            g.updater_state = jax.tree_util.tree_map(jnp.array, self.updater_state)
             g.step = self.step
         return g

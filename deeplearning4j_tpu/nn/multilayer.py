@@ -476,9 +476,11 @@ class MultiLayerNetwork:
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
         if self._initialized:
             net.init()
-            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            net.variables = jax.tree_util.tree_map(lambda a: a, self.variables)
-            net.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+            # deep-copy buffers: the jitted train step donates params/updater
+            # state, which would invalidate shared arrays on TPU
+            net.params = jax.tree_util.tree_map(jnp.array, self.params)
+            net.variables = jax.tree_util.tree_map(jnp.array, self.variables)
+            net.updater_state = jax.tree_util.tree_map(jnp.array, self.updater_state)
             net.step = self.step
         return net
 

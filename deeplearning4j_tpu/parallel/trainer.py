@@ -54,6 +54,15 @@ def _tree_put(tree, sharding):
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)
 
 
+def _require_multilayer(net):
+    from ..nn.multilayer import MultiLayerNetwork
+    if not isinstance(net, MultiLayerNetwork):
+        raise TypeError(
+            f"TrainingMaster implementations currently support MultiLayerNetwork "
+            f"only (got {type(net).__name__}); ComputationGraph distributed "
+            f"training is not yet wired")
+
+
 class IciDataParallelTrainingMaster(TrainingMaster):
     """Per-step gradient all-reduce over ICI (the TPU-native fast path).
 
@@ -68,6 +77,7 @@ class IciDataParallelTrainingMaster(TrainingMaster):
         self.stats = SparkTrainingStats() if collect_stats else None
 
     def execute_training(self, net, iterator) -> None:
+        _require_multilayer(net)
         net._check_init()
         repl = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
@@ -75,23 +85,29 @@ class IciDataParallelTrainingMaster(TrainingMaster):
         net.variables = _tree_put(net.variables, repl)
         net.updater_state = _tree_put(net.updater_state, repl)
         n_dev = self.mesh.size
-        step_fn = net._get_train_step((False, False, False))
         for ds in iterator:
             with phase_timer(self.stats, "data_fetch"):
                 x = np.asarray(ds.features)
                 y = np.asarray(ds.labels)
+                fm = getattr(ds, "features_mask", None)
+                lm = getattr(ds, "labels_mask", None)
                 if x.shape[0] % n_dev:  # pad (cyclically) to a divisible batch
                     need = -(-x.shape[0] // n_dev) * n_dev
                     idx = np.arange(need) % x.shape[0]
                     x = x[idx]
                     y = y[idx]
+                    fm = fm[idx] if fm is not None else None
+                    lm = lm[idx] if lm is not None else None
                 xs = jax.device_put(jnp.asarray(x), shard)
                 ys = jax.device_put(jnp.asarray(y), shard)
+                fms = jax.device_put(jnp.asarray(fm), shard) if fm is not None else None
+                lms = jax.device_put(jnp.asarray(lm), shard) if lm is not None else None
             with phase_timer(self.stats, "process_minibatch"):
+                step_fn = net._get_train_step((fms is not None, lms is not None, False))
                 net._key, sub = jax.random.split(net._key)
                 (net.params, net.variables, net.updater_state, loss,
                  _) = step_fn(net.params, net.variables, net.updater_state,
-                              jnp.asarray(net.step), sub, xs, ys, None, None, None)
+                              jnp.asarray(net.step), sub, xs, ys, fms, lms, None)
                 net.score_ = float(loss)
                 net.step += 1
             for listener in net.listeners:
@@ -121,6 +137,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
     # -- the shard_map'd worker round ------------------------------------------
     def _get_round_fn(self, net):
+        _require_multilayer(net)
         # cache on the net itself so the compiled round's lifetime (and its
         # closure over the net's layers) is tied to that net
         key = ("pa_round", self.averaging_frequency, self.mesh.shape_tuple)
@@ -182,11 +199,16 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 return
             x = np.concatenate(buf_x)
             y = np.concatenate(buf_y)
+            buf_x.clear()
+            buf_y.clear()
             need = n_dev * n * b
             if x.shape[0] < need:  # repeat tail to fill the round (static shapes)
                 reps = int(np.ceil(need / x.shape[0]))
                 x = np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:need]
                 y = np.tile(y, (reps,) + (1,) * (y.ndim - 1))[:need]
+            elif x.shape[0] > need:  # carry the remainder into the next round
+                buf_x.append(x[need:])
+                buf_y.append(y[need:])
             xs = x[:need].reshape((n_dev, n, b) + x.shape[1:])
             ys = y[:need].reshape((n_dev, n, b) + y.shape[1:])
             with phase_timer(self.stats, "aggregate_round"):
@@ -198,8 +220,6 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                                       jnp.asarray(xs), jnp.asarray(ys))
                 net.score_ = float(loss)
                 net.step += n
-            buf_x.clear()
-            buf_y.clear()
             for listener in net.listeners:
                 listener.iteration_done(net, net.step)
 
@@ -211,7 +231,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 have = sum(a.shape[0] for a in buf_x)
                 if have >= n_dev * n * b:
                     flush()
-            flush()
+            while buf_x:
+                flush()
 
     def get_training_stats(self):
         return self.stats

@@ -99,7 +99,9 @@ def _restore_state(net, zf: zipfile.ZipFile, load_updater: bool):
         is_dict = isinstance(net.variables, dict)
         for key, arr in var_arrays.items():
             i, name = key.rsplit(":", 1)
-            net.variables[i if is_dict else int(i)][name] = jnp.asarray(arr)
+            slot = net.variables[i if is_dict else int(i)]
+            dtype = slot[name].dtype if name in slot else None
+            slot[name] = jnp.asarray(arr, dtype)
     if META_JSON in names:
         net.step = json.loads(zf.read(META_JSON).decode()).get("step", 0)
 

@@ -1,0 +1,224 @@
+"""NLP stack tests.
+
+Mirrors the reference NLP suite (Word2VecTests, ParagraphVectorsTest,
+TfidfVectorizerTest, Huffman tests, DeepWalk tests): full fits on a small
+synthetic corpus with similarity assertions.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
+                                                 DefaultTokenizerFactory,
+                                                 EndingPreProcessor,
+                                                 NGramTokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import (VocabConstructor, build_huffman)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.paragraph import ParagraphVectors
+from deeplearning4j_tpu.nlp.tfidf import BagOfWordsVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.nlp import serializer as wvserde
+from deeplearning4j_tpu.graph.graph import Graph, GraphLoader, RandomWalkIterator
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+
+
+def _corpus(n=300, seed=7):
+    """Two topic clusters: {cat,dog,pet,fur} and {car,truck,road,wheel}."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "pet", "fur", "paw"]
+    vehicles = ["car", "truck", "road", "wheel", "engine"]
+    sentences = []
+    for _ in range(n):
+        group = animals if rng.random() < 0.5 else vehicles
+        words = [group[i] for i in rng.integers(0, len(group), 6)]
+        sentences.append(" ".join(words))
+    return sentences
+
+
+def test_tokenizers():
+    tf = DefaultTokenizerFactory()
+    assert tf.create("Hello  world foo").get_tokens() == ["Hello", "world", "foo"]
+    tf.set_token_pre_processor(CommonPreprocessor())
+    assert tf.create("Hello, World!").get_tokens() == ["hello", "world"]
+    ng = NGramTokenizerFactory(1, 2)
+    toks = ng.create("a b c").get_tokens()
+    assert "a b" in toks and "b c" in toks and "a" in toks
+    assert EndingPreProcessor().pre_process("running") == "runn"
+
+
+def test_vocab_and_huffman():
+    seqs = [["the", "cat", "sat"], ["the", "dog", "sat"], ["the", "end"]]
+    vocab = VocabConstructor(min_word_frequency=1).build_vocab(seqs)
+    assert vocab.num_words() == 5
+    assert vocab.word_at_index(0) == "the"  # most frequent first
+    assert vocab.word_frequency("the") == 3
+    build_huffman(vocab)
+    words = vocab.vocab_words()
+    # Huffman: most frequent word gets shortest code
+    the_len = len(vocab.word_for("the").codes)
+    assert all(the_len <= len(w.codes) for w in words)
+    # codes are prefix-free
+    codes = {tuple(w.codes) for w in words}
+    assert len(codes) == len(words)
+    # min frequency filtering
+    vocab2 = VocabConstructor(min_word_frequency=2).build_vocab(seqs)
+    assert vocab2.num_words() == 2  # the, sat
+
+
+def test_word2vec_similarity():
+    """Topic-cluster similarity (reference Word2VecTests.testRunWord2Vec)."""
+    w2v = (Word2Vec.builder()
+           .layer_size(32).window_size(3).min_word_frequency(2)
+           .negative_sample(5).epochs(10).learning_rate(0.05)
+           .seed(42).batch_size(512)
+           .iterate(_corpus())
+           .build())
+    w2v.fit()
+    assert w2v.has_word("cat") and w2v.has_word("car")
+    within = w2v.similarity("cat", "dog")
+    across = w2v.similarity("cat", "truck")
+    assert within > across, f"within={within} across={across}"
+    nearest = w2v.words_nearest("cat", 3)
+    animal_set = {"dog", "pet", "fur", "paw"}
+    assert len(set(nearest) & animal_set) >= 2, nearest
+    vec = w2v.word_vector("cat")
+    assert vec.shape == (32,)
+
+
+def test_word2vec_hierarchic_softmax():
+    w2v = (Word2Vec.builder()
+           .layer_size(24).window_size(3).min_word_frequency(2)
+           .negative_sample(0).use_hierarchic_softmax(True)
+           .epochs(10).learning_rate(0.05).seed(1)
+           .iterate(_corpus(200))
+           .build())
+    w2v.fit()
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "wheel")
+
+
+def test_glove():
+    g = (Glove.builder()
+         .layer_size(24).window_size(5).min_word_frequency(2)
+         .epochs(40).learning_rate(0.05).seed(3)
+         .iterate(_corpus(200))
+         .build())
+    g.fit()
+    assert g.similarity("cat", "dog") > g.similarity("cat", "truck")
+
+
+def test_paragraph_vectors():
+    """Label inference (reference ParagraphVectorsTest)."""
+    sentences = _corpus(200)
+    labels = ["animal" if any(w in s for w in ("cat", "dog", "pet", "fur", "paw"))
+              else "vehicle" for s in sentences]
+    pv = (ParagraphVectors.builder()
+          .layer_size(24).window_size(3).min_word_frequency(2)
+          .negative_sample(5).epochs(8).seed(11)
+          .documents(sentences, labels)
+          .build())
+    pv.fit()
+    assert pv.doc_vector("animal") is not None
+    sim_animal = pv.similarity_to_label("cat dog pet", "animal")
+    sim_vehicle = pv.similarity_to_label("cat dog pet", "vehicle")
+    assert sim_animal > sim_vehicle
+    assert pv.nearest_labels("truck road wheel", 1) == ["vehicle"]
+    v = pv.infer_vector("dog fur paw")
+    assert v.shape == (24,)
+
+
+def test_tfidf_and_bow():
+    docs = ["the cat sat", "the dog sat", "rockets fly high"]
+    tfidf = TfidfVectorizer().fit(docs)
+    v = tfidf.transform("the cat")
+    assert v.shape == (tfidf.vocab.num_words(),)
+    # 'the' appears in 2/3 docs -> lower idf than 'rockets' (1/3)
+    assert tfidf.idf("rockets") > tfidf.idf("the")
+    bow = BagOfWordsVectorizer().fit(docs)
+    counts = bow.transform("cat cat dog")
+    assert counts[bow.vocab.index_of("cat")] == 2
+    assert counts[bow.vocab.index_of("dog")] == 1
+
+
+def test_word_vector_serialization(tmp_path):
+    w2v = (Word2Vec.builder().layer_size(16).min_word_frequency(2)
+           .epochs(2).seed(5).iterate(_corpus(50)).build())
+    w2v.fit()
+    # text format
+    p = tmp_path / "vecs.txt"
+    wvserde.write_word_vectors(w2v, p)
+    loaded = wvserde.load_txt_vectors(p)
+    np.testing.assert_allclose(loaded.word_vector("cat"), w2v.word_vector("cat"),
+                               atol=1e-5)
+    # binary format
+    pb = tmp_path / "vecs.bin"
+    wvserde.write_word_vectors_binary(w2v, pb)
+    loaded_b = wvserde.load_binary_vectors(pb)
+    np.testing.assert_allclose(loaded_b.word_vector("dog"), w2v.word_vector("dog"),
+                               atol=1e-6)
+
+
+def _two_cluster_graph():
+    """Two 6-cliques joined by one edge."""
+    g = Graph(12)
+    for base in (0, 6):
+        for i in range(6):
+            for j in range(i + 1, 6):
+                g.add_edge(base + i, base + j)
+    g.add_edge(0, 6)
+    return g
+
+
+def test_graph_and_walks():
+    g = _two_cluster_graph()
+    assert g.num_vertices() == 12
+    assert g.degree(1) == 5
+    walks = list(RandomWalkIterator(g, walk_length=10, seed=1))
+    assert len(walks) == 12
+    assert all(len(w) == 10 for w in walks)
+    # walks stay on connected vertices
+    for w in walks:
+        for a, b in zip(w, w[1:]):
+            assert b in g.get_connected_vertices(a)
+
+
+def test_graph_loader(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("0 1\n1 2\n2 0\n")
+    g = GraphLoader.load_undirected_graph_edge_list(p)
+    assert g.num_vertices() == 3
+    assert g.num_edges() == 3
+
+
+def test_deepwalk_clusters():
+    """DeepWalk separates the two cliques (reference DeepWalk tests)."""
+    g = _two_cluster_graph()
+    dw = (DeepWalk.builder().vector_size(16).window_size(3)
+          .walk_length(20).walks_per_vertex(8).epochs(5).seed(2)
+          .build())
+    dw.fit(g)
+    within = dw.similarity(1, 2)
+    across = dw.similarity(1, 8)
+    assert within > across, f"within={within} across={across}"
+
+
+def test_word2vec_cbow_and_subsample():
+    w2v = (Word2Vec.builder()
+           .layer_size(32).window_size(3).min_word_frequency(2)
+           .negative_sample(5).epochs(10).learning_rate(0.05)
+           .seed(42).batch_size(512).cbow(True).sampling(1e-2)
+           .iterate(_corpus())
+           .build())
+    w2v.fit()
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "truck")
+
+
+def test_paragraph_vectors_dm():
+    sentences = _corpus(150)
+    labels = ["animal" if any(w in s for w in ("cat", "dog", "pet", "fur", "paw"))
+              else "vehicle" for s in sentences]
+    pv = (ParagraphVectors.builder()
+          .layer_size(24).window_size(3).min_word_frequency(2)
+          .negative_sample(5).epochs(6).seed(11).dm(True)
+          .documents(sentences, labels)
+          .build())
+    pv.fit()
+    assert pv.nearest_labels("cat dog pet", 1) == ["animal"]

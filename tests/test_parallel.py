@@ -136,3 +136,16 @@ def test_stats_collection():
     assert "data_fetch" in stats.keys()
     assert "count" in stats.stats_as_string()
     assert stats.export_json()
+
+
+def test_pa_master_trains_on_all_data_with_remainder():
+    """Buffered samples beyond one round must carry over, not be dropped."""
+    n_workers, bpw, freq = 2, 8, 2  # round = 32 examples
+    ds = _data(48, seed=9)  # 1.5 rounds
+    master = ParameterAveragingTrainingMaster(
+        batch_size_per_worker=bpw, averaging_frequency=freq,
+        mesh=default_mesh(n_workers))
+    net = _net()
+    master.execute_training(net, ListDataSetIterator(ds, 48))
+    # 48 examples = 1 full round + remainder round -> 2*freq steps
+    assert net.step == 2 * freq
