@@ -1,0 +1,134 @@
+"""Command-line interface: train / test / predict.
+
+Parity with the reference `deeplearning4j-cli` (CommandLineInterfaceDriver +
+subcommands/Train.java:66 args4j flags :80-108 — -conf properties/JSON,
+-input, -model, -output, -type, -runtime local —, Predict, Test).
+
+Usage:
+  dl4j-tpu train   --conf net.json --input data.csv --output model.zip
+                   [--epochs N] [--batch B] [--label-index I] [--num-classes C]
+                   [--runtime local|data-parallel]
+  dl4j-tpu test    --model model.zip --input data.csv [--label-index I]
+  dl4j-tpu predict --model model.zip --input data.csv [--output preds.csv]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def _build_iterator(args, num_classes=None):
+    from ..datasets.records import CSVRecordReader, RecordReaderDataSetIterator
+
+    reader = CSVRecordReader(skip_lines=args.skip_lines).initialize(args.input)
+    return RecordReaderDataSetIterator(
+        reader, batch_size=args.batch, label_index=args.label_index,
+        num_classes=num_classes or args.num_classes,
+        regression=args.regression)
+
+
+def _load_conf(path):
+    from ..nn.conf.config import MultiLayerConfiguration
+
+    return MultiLayerConfiguration.from_json(Path(path).read_text())
+
+
+def cmd_train(args) -> int:
+    from ..nn.multilayer import MultiLayerNetwork
+    from ..datasets.iterators import MultipleEpochsIterator
+    from ..optimize.listeners import ScoreIterationListener
+    from ..util import model_serializer
+
+    conf = _load_conf(args.conf)
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(ScoreIterationListener(args.print_every,
+                                             log_fn=lambda m: print(m)))
+    iterator = _build_iterator(args)
+    if args.epochs > 1:
+        iterator = MultipleEpochsIterator(args.epochs, iterator)
+    if args.runtime == "data-parallel":
+        from ..parallel.trainer import IciDataParallelTrainingMaster
+        IciDataParallelTrainingMaster().execute_training(net, iterator)
+    else:
+        net.fit(iterator)
+    model_serializer.write_model(net, args.output)
+    print(f"Model saved to {args.output} (final score {net.score_:.6f})")
+    return 0
+
+
+def cmd_test(args) -> int:
+    from ..util import model_serializer
+
+    net = model_serializer.restore_multi_layer_network(args.model)
+    iterator = _build_iterator(args)
+    ev = net.evaluate(iterator)
+    print(ev.stats())
+    return 0
+
+
+def cmd_predict(args) -> int:
+    import numpy as np
+    from ..util import model_serializer
+
+    net = model_serializer.restore_multi_layer_network(args.model)
+    iterator = _build_iterator(args)
+    preds = []
+    for ds in iterator:
+        preds.extend(net.predict(ds.features).tolist())
+    if args.output:
+        Path(args.output).write_text("\n".join(str(p) for p in preds) + "\n")
+        print(f"{len(preds)} predictions written to {args.output}")
+    else:
+        for p in preds:
+            print(p)
+    return 0
+
+
+def _add_data_args(p: argparse.ArgumentParser):
+    p.add_argument("--input", required=True, help="input CSV path")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--label-index", type=int, default=-1,
+                   help="label column (-1 = last)")
+    p.add_argument("--num-classes", type=int, default=None)
+    p.add_argument("--regression", action="store_true")
+    p.add_argument("--skip-lines", type=int, default=0)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dl4j-tpu",
+        description="TPU-native deep learning CLI (train/test/predict)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    t = sub.add_parser("train", help="train a model from a JSON configuration")
+    t.add_argument("--conf", required=True, help="MultiLayerConfiguration JSON")
+    t.add_argument("--output", required=True, help="output model zip")
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--print-every", type=int, default=10)
+    t.add_argument("--runtime", choices=["local", "data-parallel"],
+                   default="local")
+    _add_data_args(t)
+    t.set_defaults(func=cmd_train)
+
+    e = sub.add_parser("test", help="evaluate a saved model")
+    e.add_argument("--model", required=True)
+    _add_data_args(e)
+    e.set_defaults(func=cmd_test)
+
+    p = sub.add_parser("predict", help="predict with a saved model")
+    p.add_argument("--model", required=True)
+    p.add_argument("--output", default=None)
+    _add_data_args(p)
+    p.set_defaults(func=cmd_predict)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""Spatial search trees: VP-tree and KD-tree.
+
+Parity with the reference `clustering/vptree/` (nearest-neighbor search used
+by the UI's nearest-neighbors view) and `clustering/kdtree/`. These are
+host-side index structures in the reference too (Java object trees); queries
+here are exact.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class VPTree:
+    """Vantage-point tree (reference clustering/vptree/VPTree.java)."""
+
+    class _Node:
+        __slots__ = ("index", "threshold", "left", "right")
+
+        def __init__(self, index):
+            self.index = index
+            self.threshold = 0.0
+            self.left = None
+            self.right = None
+
+    def __init__(self, items: np.ndarray, labels: Optional[List[str]] = None,
+                 seed: int = 0):
+        self.items = np.asarray(items, np.float64)
+        self.labels = labels
+        self._rng = np.random.default_rng(seed)
+        idx = list(range(self.items.shape[0]))
+        self._root = self._build(idx)
+
+    def _dist(self, a: int, b: int) -> float:
+        return float(np.linalg.norm(self.items[a] - self.items[b]))
+
+    def _build(self, idx: List[int]):
+        if not idx:
+            return None
+        if len(idx) == 1:
+            return VPTree._Node(idx[0])
+        vp_pos = int(self._rng.integers(0, len(idx)))
+        idx[0], idx[vp_pos] = idx[vp_pos], idx[0]
+        vp = idx[0]
+        rest = idx[1:]
+        dists = [self._dist(vp, i) for i in rest]
+        median = float(np.median(dists)) if dists else 0.0
+        node = VPTree._Node(vp)
+        node.threshold = median
+        inner = [i for i, d in zip(rest, dists) if d < median]
+        outer = [i for i, d in zip(rest, dists) if d >= median]
+        node.left = self._build(inner)
+        node.right = self._build(outer)
+        return node
+
+    def search(self, target, k: int = 1) -> Tuple[List[int], List[float]]:
+        """k nearest neighbors of `target`: (indices, distances)."""
+        target = np.asarray(target, np.float64)
+        heap: List[Tuple[float, int]] = []  # max-heap via negated distance
+        tau = [np.inf]
+
+        def visit(node):
+            if node is None:
+                return
+            d = float(np.linalg.norm(self.items[node.index] - target))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+            elif d < tau[0]:
+                heapq.heapreplace(heap, (-d, node.index))
+                tau[0] = -heap[0][0]
+            if node.left is None and node.right is None:
+                return
+            if d < node.threshold:
+                visit(node.left)
+                if d + tau[0] >= node.threshold:
+                    visit(node.right)
+            else:
+                visit(node.right)
+                if d - tau[0] <= node.threshold:
+                    visit(node.left)
+
+        visit(self._root)
+        pairs = sorted(((-nd, i) for nd, i in heap))
+        return [i for _, i in pairs], [d for d, _ in pairs]
+
+    def nearest_labels(self, target, k: int = 1) -> List[str]:
+        idx, _ = self.search(target, k)
+        return [self.labels[i] for i in idx]
+
+
+class KDTree:
+    """KD-tree (reference clustering/kdtree/KDTree.java)."""
+
+    class _Node:
+        __slots__ = ("index", "axis", "left", "right")
+
+        def __init__(self, index, axis):
+            self.index = index
+            self.axis = axis
+            self.left = None
+            self.right = None
+
+    def __init__(self, points: np.ndarray):
+        self.points = np.asarray(points, np.float64)
+        self.dims = self.points.shape[1]
+        self._root = self._build(list(range(self.points.shape[0])), 0)
+
+    def _build(self, idx: List[int], depth: int):
+        if not idx:
+            return None
+        axis = depth % self.dims
+        idx.sort(key=lambda i: self.points[i, axis])
+        mid = len(idx) // 2
+        node = KDTree._Node(idx[mid], axis)
+        node.left = self._build(idx[:mid], depth + 1)
+        node.right = self._build(idx[mid + 1:], depth + 1)
+        return node
+
+    def nn(self, target) -> Tuple[int, float]:
+        target = np.asarray(target, np.float64)
+        best = [(-1, np.inf)]
+
+        def visit(node):
+            if node is None:
+                return
+            d = float(np.linalg.norm(self.points[node.index] - target))
+            if d < best[0][1]:
+                best[0] = (node.index, d)
+            diff = target[node.axis] - self.points[node.index, node.axis]
+            near, far = (node.left, node.right) if diff < 0 else (node.right, node.left)
+            visit(near)
+            if abs(diff) < best[0][1]:
+                visit(far)
+
+        visit(self._root)
+        return best[0]

@@ -1,0 +1,275 @@
+"""Record readers: the Canova-equivalent ingestion layer.
+
+Parity with Canova's `RecordReader` SPI and the reference's bridges
+(datasets/canova/RecordReaderDataSetIterator.java,
+SequenceRecordReaderDataSetIterator, RecordReaderMultiDataSetIterator):
+CSV records, CSV sequences (one file per sequence), in-memory string lists,
+and image directories, plus iterators that vectorize records into DataSets.
+"""
+from __future__ import annotations
+
+import csv
+import io
+from pathlib import Path
+from typing import Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .dataset import DataSet
+from .fetchers import one_hot
+from .iterators import DataSetIterator
+
+
+class RecordReader:
+    """Canova RecordReader SPI: iterate records (lists of values)."""
+
+    def initialize(self, source) -> "RecordReader":
+        raise NotImplementedError
+
+    def next_record(self) -> Optional[List]:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_record()
+
+
+class CSVRecordReader(RecordReader):
+    """Reference Canova CSVRecordReader (skip lines + delimiter)."""
+
+    def __init__(self, skip_lines: int = 0, delimiter: str = ","):
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self._rows: List[List[str]] = []
+        self._pos = 0
+
+    def initialize(self, source: Union[str, Path]) -> "CSVRecordReader":
+        text = Path(source).read_text()
+        rows = list(csv.reader(io.StringIO(text), delimiter=self.delimiter))
+        self._rows = [r for r in rows[self.skip_lines:] if r]
+        self._pos = 0
+        return self
+
+    def next_record(self):
+        if self._pos >= len(self._rows):
+            return None
+        r = self._rows[self._pos]
+        self._pos += 1
+        return r
+
+    def has_next(self):
+        return self._pos < len(self._rows)
+
+    def reset(self):
+        self._pos = 0
+
+
+class ListStringRecordReader(RecordReader):
+    """In-memory records (reference ListStringRecordReader)."""
+
+    def __init__(self):
+        self._rows: List[List[str]] = []
+        self._pos = 0
+
+    def initialize(self, rows: Sequence[Sequence[str]]) -> "ListStringRecordReader":
+        self._rows = [list(r) for r in rows]
+        self._pos = 0
+        return self
+
+    def next_record(self):
+        if self._pos >= len(self._rows):
+            return None
+        r = self._rows[self._pos]
+        self._pos += 1
+        return r
+
+    def has_next(self):
+        return self._pos < len(self._rows)
+
+    def reset(self):
+        self._pos = 0
+
+
+class CSVSequenceRecordReader:
+    """One CSV file per sequence (reference CSVSequenceRecordReader; see test
+    resources csvsequence_0.txt etc.)."""
+
+    def __init__(self, skip_lines: int = 0, delimiter: str = ","):
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self._files: List[Path] = []
+        self._pos = 0
+
+    def initialize(self, files: Sequence[Union[str, Path]]) -> "CSVSequenceRecordReader":
+        self._files = [Path(f) for f in files]
+        self._pos = 0
+        return self
+
+    def next_sequence(self) -> Optional[List[List[str]]]:
+        if self._pos >= len(self._files):
+            return None
+        text = self._files[self._pos].read_text()
+        self._pos += 1
+        rows = list(csv.reader(io.StringIO(text), delimiter=self.delimiter))
+        return [r for r in rows[self.skip_lines:] if r]
+
+    def has_next(self):
+        return self._pos < len(self._files)
+
+    def reset(self):
+        self._pos = 0
+
+
+class ImageRecordReader(RecordReader):
+    """Image directory reader: label = parent dir name (reference Canova
+    ImageRecordReader). Uses PIL when available, else raw numpy .npy files."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.height = height
+        self.width = width
+        self.channels = channels
+        self._files: List[Path] = []
+        self.labels: List[str] = []
+        self._pos = 0
+
+    def initialize(self, root: Union[str, Path]) -> "ImageRecordReader":
+        root = Path(root)
+        exts = {".png", ".jpg", ".jpeg", ".bmp", ".npy"}
+        self._files = sorted(p for p in root.rglob("*") if p.suffix.lower() in exts)
+        self.labels = sorted({p.parent.name for p in self._files})
+        self._pos = 0
+        return self
+
+    def _load(self, path: Path) -> np.ndarray:
+        if path.suffix == ".npy":
+            arr = np.load(path)
+        else:
+            from PIL import Image
+            img = Image.open(path).convert("RGB" if self.channels == 3 else "L")
+            img = img.resize((self.width, self.height))
+            arr = np.asarray(img, np.float32) / 255.0
+        arr = np.asarray(arr, np.float32)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.reshape(self.height, self.width, self.channels)
+
+    def next_record(self):
+        if self._pos >= len(self._files):
+            return None
+        p = self._files[self._pos]
+        self._pos += 1
+        return [self._load(p), self.labels.index(p.parent.name)]
+
+    def has_next(self):
+        return self._pos < len(self._files)
+
+    def reset(self):
+        self._pos = 0
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Vectorize records into DataSets
+    (reference datasets/canova/RecordReaderDataSetIterator.java):
+    label_index column -> one-hot labels, remaining columns -> features."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int = -1, num_classes: Optional[int] = None,
+                 regression: bool = False):
+        self.reader = reader
+        self._batch = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def reset(self):
+        self.reader.reset()
+
+    def next_batch(self) -> Optional[DataSet]:
+        feats, labs = [], []
+        while len(feats) < self._batch and self.reader.has_next():
+            rec = self.reader.next_record()
+            if rec is None:
+                break
+            if isinstance(rec[0], np.ndarray):  # image record
+                feats.append(rec[0].reshape(-1))
+                labs.append(rec[1])
+                continue
+            vals = [float(v) for v in rec]
+            li = self.label_index if self.label_index >= 0 else len(vals) - 1
+            labs.append(vals[li])
+            feats.append([v for i, v in enumerate(vals) if i != li])
+        if not feats:
+            return None
+        x = np.asarray(feats, np.float32)
+        if self.regression:
+            y = np.asarray(labs, np.float32).reshape(-1, 1)
+        else:
+            y = one_hot(np.asarray(labs), self.num_classes
+                        or int(max(labs)) + 1)
+        return DataSet(x, y)
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Sequence records -> [B, T, F] DataSets with masks for ragged lengths
+    (reference SequenceRecordReaderDataSetIterator)."""
+
+    def __init__(self, feature_reader: CSVSequenceRecordReader,
+                 label_reader: Optional[CSVSequenceRecordReader],
+                 batch_size: int, num_classes: Optional[int] = None,
+                 regression: bool = False):
+        self.feature_reader = feature_reader
+        self.label_reader = label_reader
+        self._batch = batch_size
+        self.num_classes = num_classes
+        self.regression = regression
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def reset(self):
+        self.feature_reader.reset()
+        if self.label_reader is not None:
+            self.label_reader.reset()
+
+    def next_batch(self) -> Optional[DataSet]:
+        seqs, labseqs = [], []
+        while len(seqs) < self._batch and self.feature_reader.has_next():
+            frows = self.feature_reader.next_sequence()
+            seqs.append(np.asarray(frows, np.float32))
+            if self.label_reader is not None and self.label_reader.has_next():
+                lrows = self.label_reader.next_sequence()
+                labseqs.append(np.asarray(lrows, np.float32))
+        if not seqs:
+            return None
+        max_t = max(s.shape[0] for s in seqs)
+        B = len(seqs)
+        F = seqs[0].shape[1]
+        x = np.zeros((B, max_t, F), np.float32)
+        mask = np.zeros((B, max_t), np.float32)
+        for i, s in enumerate(seqs):
+            x[i, :s.shape[0]] = s
+            mask[i, :s.shape[0]] = 1.0
+        if not labseqs:
+            return DataSet(x, x, features_mask=mask, labels_mask=mask)
+        if self.regression:
+            L = labseqs[0].shape[1]
+            y = np.zeros((B, max_t, L), np.float32)
+            for i, l in enumerate(labseqs):
+                y[i, :l.shape[0]] = l
+        else:
+            C = self.num_classes or int(max(l.max() for l in labseqs)) + 1
+            y = np.zeros((B, max_t, C), np.float32)
+            for i, l in enumerate(labseqs):
+                idx = l.reshape(-1).astype(int)
+                y[i, np.arange(len(idx)), idx] = 1.0
+        return DataSet(x, y, features_mask=mask, labels_mask=mask)

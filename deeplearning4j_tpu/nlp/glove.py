@@ -19,7 +19,7 @@ import numpy as np
 from .sentence_iterator import CollectionSentenceIterator
 from .tokenization import DefaultTokenizerFactory, TokenizerFactory
 from .vocab import VocabCache, VocabConstructor
-from .word2vec import SequenceVectors
+from .word2vec import MappedBuilder, SequenceVectors
 
 
 class AbstractCoOccurrences:
@@ -68,41 +68,13 @@ class Glove(SequenceVectors):
         self._iterator = None
         self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
 
-    class Builder:
-        def __init__(self):
-            self._kw = {}
-            self._iterator = None
-            self._tokenizer = DefaultTokenizerFactory()
-
-        def __getattr__(self, name):
-            mapping = {"layer_size": "layer_size", "window_size": "window",
-                       "min_word_frequency": "min_word_frequency",
-                       "learning_rate": "learning_rate", "epochs": "epochs",
-                       "iterations": "epochs", "batch_size": "batch_size",
-                       "seed": "seed", "x_max": "x_max", "alpha": "alpha",
-                       "symmetric": "symmetric"}
-            if name in mapping:
-                def setter(value):
-                    self._kw[mapping[name]] = value
-                    return self
-                return setter
-            raise AttributeError(name)
-
-        def iterate(self, iterator):
-            if isinstance(iterator, (list, tuple)):
-                iterator = CollectionSentenceIterator(iterator)
-            self._iterator = iterator
-            return self
-
-        def tokenizer_factory(self, tf):
-            self._tokenizer = tf
-            return self
-
-        def build(self) -> "Glove":
-            g = Glove(**self._kw)
-            g._iterator = self._iterator
-            g._tokenizer = self._tokenizer
-            return g
+    class Builder(MappedBuilder):
+        MAPPING = {"layer_size": "layer_size", "window_size": "window",
+                   "min_word_frequency": "min_word_frequency",
+                   "learning_rate": "learning_rate", "epochs": "epochs",
+                   "iterations": "epochs", "batch_size": "batch_size",
+                   "seed": "seed", "x_max": "x_max", "alpha": "alpha",
+                   "symmetric": "symmetric"}
 
     @staticmethod
     def builder() -> "Glove.Builder":
@@ -175,3 +147,6 @@ class Glove(SequenceVectors):
         self.lookup_table.syn0 = w + wc
         self.score_ = last
         return self
+
+
+Glove.Builder.TARGET_CLS = Glove

@@ -17,7 +17,7 @@ import numpy as np
 
 from .sentence_iterator import LabelledCollectionSentenceIterator
 from .tokenization import DefaultTokenizerFactory, TokenizerFactory
-from .word2vec import SequenceVectors, _log_sigmoid
+from .word2vec import MappedBuilder, SequenceVectors, _log_sigmoid
 
 
 class ParagraphVectors(SequenceVectors):
@@ -34,28 +34,20 @@ class ParagraphVectors(SequenceVectors):
         self.doc_vectors: Optional[jnp.ndarray] = None
         self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
 
-    class Builder:
+    class Builder(MappedBuilder):
+        MAPPING = {"layer_size": "layer_size", "window_size": "window",
+                   "min_word_frequency": "min_word_frequency",
+                   "negative_sample": "negative",
+                   "learning_rate": "learning_rate",
+                   "min_learning_rate": "min_learning_rate",
+                   "epochs": "epochs", "iterations": "epochs",
+                   "batch_size": "batch_size", "seed": "seed",
+                   "grad_clip": "grad_clip", "dm": "dm"}
+
         def __init__(self):
-            self._kw = {}
+            super().__init__()
             self._sentences: List[str] = []
             self._labels: List[str] = []
-            self._tokenizer = DefaultTokenizerFactory()
-
-        def __getattr__(self, name):
-            mapping = {"layer_size": "layer_size", "window_size": "window",
-                       "min_word_frequency": "min_word_frequency",
-                       "negative_sample": "negative",
-                       "learning_rate": "learning_rate",
-                       "min_learning_rate": "min_learning_rate",
-                       "epochs": "epochs", "iterations": "epochs",
-                       "batch_size": "batch_size", "seed": "seed",
-                       "dm": "dm"}
-            if name in mapping:
-                def setter(value):
-                    self._kw[mapping[name]] = value
-                    return self
-                return setter
-            raise AttributeError(name)
 
         def iterate(self, iterator: LabelledCollectionSentenceIterator):
             self._sentences = list(iterator._sentences)
@@ -65,10 +57,6 @@ class ParagraphVectors(SequenceVectors):
         def documents(self, sentences: List[str], labels: List[str]):
             self._sentences = sentences
             self._labels = labels
-            return self
-
-        def tokenizer_factory(self, tf):
-            self._tokenizer = tf
             return self
 
         def build(self) -> "ParagraphVectors":
@@ -239,7 +227,11 @@ class ParagraphVectors(SequenceVectors):
         tokens = self._tokenizer.create(text).get_tokens()
         idx = np.asarray([self.vocab.index_of(t) for t in tokens
                           if self.vocab.index_of(t) >= 0], np.int32)
-        rng = np.random.default_rng(abs(hash(text)) % (2**31))
+        import zlib
+        # stable per-text seed (process hash randomization would make
+        # inference non-reproducible)
+        rng = np.random.default_rng((zlib.crc32(text.encode()) ^ self.seed)
+                                    & 0x7FFFFFFF)
         vec = jnp.asarray((rng.random(self.layer_size, np.float32) - 0.5)
                           / self.layer_size)
         if idx.size == 0:
@@ -273,3 +265,6 @@ class ParagraphVectors(SequenceVectors):
         order = np.argsort(-sims)
         inv = {i: l for l, i in self.label_index.items()}
         return [inv[int(i)] for i in order[:n]]
+
+
+ParagraphVectors.Builder.TARGET_CLS = ParagraphVectors

@@ -15,6 +15,16 @@ from .vocab import VocabCache, VocabWord
 from .word2vec import InMemoryLookupTable, SequenceVectors
 
 
+def _escape(word: str) -> str:
+    # word2vec's space-delimited formats cannot hold spaces (n-gram vocab
+    # entries); escape them reversibly, leaving external files unaffected
+    return word.replace("%", "%25").replace(" ", "%20")
+
+
+def _unescape(word: str) -> str:
+    return word.replace("%20", " ").replace("%25", "%")
+
+
 def write_word_vectors(model: SequenceVectors, path) -> None:
     """word2vec text format: header 'V D', then 'word v1 v2 ...' per line."""
     path = Path(path)
@@ -23,7 +33,7 @@ def write_word_vectors(model: SequenceVectors, path) -> None:
         f.write(f"{model.vocab.num_words()} {model.layer_size}\n")
         for vw in model.vocab.vocab_words():
             vec = " ".join(f"{x:.6f}" for x in syn0[vw.index])
-            f.write(f"{vw.word} {vec}\n")
+            f.write(f"{_escape(vw.word)} {vec}\n")
 
 
 def load_txt_vectors(path) -> SequenceVectors:
@@ -37,7 +47,7 @@ def load_txt_vectors(path) -> SequenceVectors:
             parts = line.rstrip("\n").split(" ")
             if len(parts) < d + 1:
                 continue
-            words.append(parts[0])
+            words.append(_unescape(parts[0]))
             vectors.append(np.asarray(parts[1:d + 1], np.float32))
     model = SequenceVectors(layer_size=d)
     cache = VocabCache()
@@ -60,7 +70,7 @@ def write_word_vectors_binary(model: SequenceVectors, path) -> None:
     with open(path, "wb") as f:
         f.write(f"{model.vocab.num_words()} {model.layer_size}\n".encode())
         for vw in model.vocab.vocab_words():
-            f.write(vw.word.encode("utf-8") + b" ")
+            f.write(_escape(vw.word).encode("utf-8") + b" ")
             f.write(syn0[vw.index].tobytes())
             f.write(b"\n")
 
@@ -80,7 +90,7 @@ def load_binary_vectors(path) -> SequenceVectors:
                 word.extend(ch)
             vec = np.frombuffer(f.read(4 * d), np.float32)
             f.read(1)  # trailing newline
-            words.append(word.decode("utf-8", errors="replace"))
+            words.append(_unescape(word.decode("utf-8", errors="replace")))
             vectors.append(vec)
     model = SequenceVectors(layer_size=d)
     cache = VocabCache()

@@ -269,6 +269,9 @@ class SequenceVectors:
                           * self.window * self.epochs)
         if self.cbow and self.negative <= 0:
             raise ValueError("CBOW requires negative sampling (negative > 0)")
+        if self.negative <= 0 and not self.use_hs:
+            raise ValueError("Enable negative sampling (negative > 0) and/or "
+                             "hierarchic softmax (use_hierarchic_softmax=True)")
         step_cbow = self._make_cbow_step() if self.cbow else None
         seen = 0
         B = self.batch_size
@@ -375,48 +378,62 @@ class SequenceVectors:
         return out
 
 
+class MappedBuilder:
+    """Shared fluent-builder machinery for the embedding model facades:
+    subclasses define TARGET_CLS and MAPPING (fluent name -> ctor kwarg)."""
+
+    TARGET_CLS: type = None
+    MAPPING: Dict[str, str] = {}
+
+    def __init__(self):
+        self._kw = {}
+        self._iterator = None
+        self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
+
+    def __getattr__(self, name):
+        if name in type(self).MAPPING:
+            def setter(value):
+                self._kw[type(self).MAPPING[name]] = value
+                return self
+            return setter
+        raise AttributeError(name)
+
+    def iterate(self, iterator):
+        if isinstance(iterator, (list, tuple)):
+            iterator = CollectionSentenceIterator(iterator)
+        self._iterator = iterator
+        return self
+
+    def tokenizer_factory(self, tf: TokenizerFactory):
+        self._tokenizer = tf
+        return self
+
+    def build(self):
+        model = type(self).TARGET_CLS(**self._kw)
+        model._iterator = self._iterator
+        model._tokenizer = self._tokenizer
+        return model
+
+
+_COMMON_MAPPING = {
+    "layer_size": "layer_size", "window_size": "window",
+    "min_word_frequency": "min_word_frequency",
+    "learning_rate": "learning_rate", "epochs": "epochs",
+    "iterations": "epochs", "batch_size": "batch_size", "seed": "seed",
+    "grad_clip": "grad_clip",
+}
+
+
 class Word2Vec(SequenceVectors):
     """Builder facade (reference models/word2vec/Word2Vec.java)."""
 
-    class Builder:
-        def __init__(self):
-            self._kw = {}
-            self._iterator: Optional[SentenceIterator] = None
-            self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
-
-        def __getattr__(self, name):
-            mapping = {
-                "layer_size": "layer_size", "window_size": "window",
-                "min_word_frequency": "min_word_frequency",
-                "negative_sample": "negative", "learning_rate": "learning_rate",
-                "min_learning_rate": "min_learning_rate", "epochs": "epochs",
-                "iterations": "epochs", "batch_size": "batch_size",
-                "seed": "seed", "sampling": "subsample",
-                "use_hierarchic_softmax": "use_hierarchic_softmax",
-                "cbow": "cbow",
-            }
-            if name in mapping:
-                def setter(value):
-                    self._kw[mapping[name]] = value
-                    return self
-                return setter
-            raise AttributeError(name)
-
-        def iterate(self, iterator):
-            if isinstance(iterator, (list, tuple)):
-                iterator = CollectionSentenceIterator(iterator)
-            self._iterator = iterator
-            return self
-
-        def tokenizer_factory(self, tf: TokenizerFactory):
-            self._tokenizer = tf
-            return self
-
-        def build(self) -> "Word2Vec":
-            w2v = Word2Vec(**self._kw)
-            w2v._iterator = self._iterator
-            w2v._tokenizer = self._tokenizer
-            return w2v
+    class Builder(MappedBuilder):
+        MAPPING = dict(_COMMON_MAPPING,
+                       negative_sample="negative",
+                       min_learning_rate="min_learning_rate",
+                       sampling="subsample",
+                       use_hierarchic_softmax="use_hierarchic_softmax",
+                       cbow="cbow")
 
     @staticmethod
     def builder() -> "Word2Vec.Builder":
@@ -426,3 +443,6 @@ class Word2Vec(SequenceVectors):
         sequences = [self._tokenizer.create(s).get_tokens()
                      for s in self._iterator]
         return self.fit_sequences(sequences)
+
+
+Word2Vec.Builder.TARGET_CLS = Word2Vec

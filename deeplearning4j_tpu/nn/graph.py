@@ -336,22 +336,30 @@ class ComputationGraph:
                                         train=train, rng=None)
         return acts
 
-    def score(self, ds=None, inputs=None, labels=None, lmasks=None) -> float:
+    def score(self, ds=None, inputs=None, labels=None, lmasks=None,
+              fmasks=None) -> float:
         self._check_init()
         if ds is not None:
             if hasattr(ds, "features_masks"):
                 inputs, labels = ds.features, ds.labels
                 lmasks = ds.labels_masks
+                fmasks = ds.features_masks
             else:
                 inputs, labels = [ds.features], [ds.labels]
                 lm = getattr(ds, "labels_mask", None)
                 lmasks = [lm] if lm is not None else None
+                fm = getattr(ds, "features_mask", None)
+                fmasks = [fm] if fm is not None else None
         inputs = [jnp.asarray(a) for a in inputs]
         labels = [jnp.asarray(a) for a in labels]
         if lmasks is not None:
             lmasks = [jnp.asarray(m) if m is not None else None for m in lmasks]
+        fmask_dict = None
+        if fmasks is not None:
+            fmask_dict = {name: (jnp.asarray(m) if m is not None else None)
+                          for name, m in zip(self.conf.network_inputs, fmasks)}
         acts, _, _ = self._forward_impl(self.params, self.variables, inputs,
-                                        train=False, rng=None)
+                                        train=False, rng=None, fmasks=fmask_dict)
         return float(self._loss(acts, labels, lmasks) + self._reg_loss(self.params))
 
     def rnn_time_step(self, *inputs) -> List[Array]:

@@ -1,0 +1,271 @@
+"""Classic optimizers: SGD / line search / conjugate gradient / LBFGS.
+
+Parity with the reference `optimize/` package (SURVEY.md §2.2 'Optimizers'):
+Solver.java:41 (builder + optimize()), BaseOptimizer.java:51,
+StochasticGradientDescent.java:53, ConjugateGradient, LBFGS,
+LineGradientDescent, BackTrackLineSearch (Armijo), step functions and
+termination conditions (EpsTermination, ZeroDirection, Norm2Termination) —
+tested in the reference by optimize/solver/TestOptimizers on
+Sphere/Rosenbrock/Rastrigin.
+
+These operate on a generic differentiable objective f(params)->scalar over a
+flat jnp vector (jax.grad supplies gradients), independent of the network
+train path (which uses the fused jit step in MultiLayerNetwork).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+Objective = Callable[[Array], Array]
+
+
+# -- termination conditions (reference optimize/terminations/*) ----------------
+
+class TerminationCondition:
+    def terminate(self, cost: float, old_cost: float, direction: np.ndarray) -> bool:
+        raise NotImplementedError
+
+
+class EpsTermination(TerminationCondition):
+    def __init__(self, eps: float = 1e-10, tolerance: float = 1e-5):
+        self.eps = eps
+        self.tolerance = tolerance
+
+    def terminate(self, cost, old_cost, direction):
+        return abs(old_cost - cost) <= self.tolerance * max(
+            abs(old_cost) + abs(cost), self.eps)
+
+
+class Norm2Termination(TerminationCondition):
+    def __init__(self, gradient_tolerance: float = 1e-8):
+        self.tol = gradient_tolerance
+
+    def terminate(self, cost, old_cost, direction):
+        return float(np.linalg.norm(direction)) < self.tol
+
+
+class ZeroDirection(TerminationCondition):
+    def terminate(self, cost, old_cost, direction):
+        return float(np.abs(direction).max()) == 0.0
+
+
+# -- line search (reference optimize/solvers/BackTrackLineSearch.java) ---------
+
+class BackTrackLineSearch:
+    def __init__(self, objective: Objective, max_iterations: int = 20,
+                 c1: float = 1e-4, shrink: float = 0.5, initial_step: float = 1.0):
+        self.objective = objective
+        self.max_iterations = max_iterations
+        self.c1 = c1
+        self.shrink = shrink
+        self.initial_step = initial_step
+        self._jit_f = jax.jit(objective)
+
+    def optimize(self, params: Array, gradient: Array, direction: Array) -> float:
+        """Armijo backtracking: returns the accepted step size."""
+        f0 = float(self._jit_f(params))
+        slope = float(jnp.vdot(gradient, direction))
+        if slope >= 0:
+            return 0.0
+        step = self.initial_step
+        for _ in range(self.max_iterations):
+            f1 = float(self._jit_f(params + step * direction))
+            if f1 <= f0 + self.c1 * step * slope:
+                return step
+            step *= self.shrink
+        return 0.0
+
+
+# -- optimizers (reference optimize/solvers/*) ---------------------------------
+
+class BaseOptimizer:
+    def __init__(self, objective: Objective, max_iterations: int = 100,
+                 terminations: Optional[List[TerminationCondition]] = None,
+                 learning_rate: float = 0.1):
+        self.objective = objective
+        self.max_iterations = max_iterations
+        self.terminations = terminations or [EpsTermination(), ZeroDirection()]
+        self.learning_rate = learning_rate
+        self._vg = jax.jit(jax.value_and_grad(objective))
+        self.score_ = float("nan")
+
+    def optimize(self, params) -> np.ndarray:
+        raise NotImplementedError
+
+    def _terminate(self, cost, old_cost, direction) -> bool:
+        if old_cost is None or not np.isfinite(old_cost):
+            return False  # no previous cost yet
+        return any(t.terminate(cost, old_cost, direction) for t in self.terminations)
+
+
+class StochasticGradientDescent(BaseOptimizer):
+    """Reference StochasticGradientDescent.java:53."""
+
+    def optimize(self, params) -> np.ndarray:
+        p = jnp.asarray(params)
+        old_cost = None
+        for _ in range(self.max_iterations):
+            cost, grad = self._vg(p)
+            p = p - self.learning_rate * grad
+            cost = float(cost)
+            if self._terminate(cost, old_cost, np.asarray(grad)):
+                break
+            old_cost = cost
+        self.score_ = float(self._vg(p)[0])
+        return np.asarray(p)
+
+
+class LineGradientDescent(BaseOptimizer):
+    """Steepest descent + Armijo line search (reference LineGradientDescent)."""
+
+    def optimize(self, params) -> np.ndarray:
+        p = jnp.asarray(params)
+        ls = BackTrackLineSearch(self.objective)
+        old_cost = None
+        for _ in range(self.max_iterations):
+            cost, grad = self._vg(p)
+            direction = -grad
+            step = ls.optimize(p, grad, direction)
+            if step == 0.0:
+                break
+            p = p + step * direction
+            cost = float(cost)
+            if self._terminate(cost, old_cost, np.asarray(direction)):
+                break
+            old_cost = cost
+        self.score_ = float(self._vg(p)[0])
+        return np.asarray(p)
+
+
+class ConjugateGradient(BaseOptimizer):
+    """Polak-Ribiere nonlinear CG (reference ConjugateGradient.java)."""
+
+    def optimize(self, params) -> np.ndarray:
+        p = jnp.asarray(params)
+        ls = BackTrackLineSearch(self.objective)
+        cost, grad = self._vg(p)
+        direction = -grad
+        old_cost = float(cost)
+        for _ in range(self.max_iterations):
+            step = ls.optimize(p, grad, direction)
+            if step == 0.0:
+                break
+            p = p + step * direction
+            new_cost, new_grad = self._vg(p)
+            # Polak-Ribiere beta with restart
+            denom = float(jnp.vdot(grad, grad))
+            beta = float(jnp.vdot(new_grad, new_grad - grad)) / max(denom, 1e-12)
+            beta = max(0.0, beta)
+            direction = -new_grad + beta * direction
+            # restart with steepest descent if conjugacy is lost
+            if float(jnp.vdot(direction, new_grad)) >= 0:
+                direction = -new_grad
+            if self._terminate(float(new_cost), old_cost, np.asarray(direction)):
+                break
+            old_cost = float(new_cost)
+            grad = new_grad
+        self.score_ = float(self._vg(p)[0])
+        return np.asarray(p)
+
+
+class LBFGS(BaseOptimizer):
+    """Limited-memory BFGS with two-loop recursion (reference LBFGS.java)."""
+
+    def __init__(self, objective: Objective, max_iterations: int = 100,
+                 memory: int = 10, **kw):
+        super().__init__(objective, max_iterations, **kw)
+        self.memory = memory
+
+    def optimize(self, params) -> np.ndarray:
+        p = jnp.asarray(params)
+        ls = BackTrackLineSearch(self.objective)
+        s_hist: List[Array] = []
+        y_hist: List[Array] = []
+        cost, grad = self._vg(p)
+        old_cost = float(cost)
+        for _ in range(self.max_iterations):
+            # two-loop recursion
+            q = grad
+            alphas = []
+            for s, y in zip(reversed(s_hist), reversed(y_hist)):
+                rho = 1.0 / float(jnp.vdot(y, s))
+                a = rho * float(jnp.vdot(s, q))
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if y_hist:
+                s, y = s_hist[-1], y_hist[-1]
+                gamma = float(jnp.vdot(s, y)) / max(float(jnp.vdot(y, y)), 1e-12)
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * float(jnp.vdot(y, q))
+                q = q + (a - b) * s
+            direction = -q
+            step = ls.optimize(p, grad, direction)
+            if step == 0.0:
+                break
+            p_new = p + step * direction
+            new_cost, new_grad = self._vg(p_new)
+            s_vec = p_new - p
+            y_vec = new_grad - grad
+            if float(jnp.vdot(s_vec, y_vec)) > 1e-10:
+                s_hist.append(s_vec)
+                y_hist.append(y_vec)
+                if len(s_hist) > self.memory:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+            p, grad = p_new, new_grad
+            if self._terminate(float(new_cost), old_cost, np.asarray(direction)):
+                break
+            old_cost = float(new_cost)
+        self.score_ = float(self._vg(p)[0])
+        return np.asarray(p)
+
+
+OPTIMIZERS = {
+    "stochastic_gradient_descent": StochasticGradientDescent,
+    "sgd": StochasticGradientDescent,
+    "line_gradient_descent": LineGradientDescent,
+    "conjugate_gradient": ConjugateGradient,
+    "lbfgs": LBFGS,
+}
+
+
+class Solver:
+    """Builder facade (reference optimize/Solver.java:41)."""
+
+    def __init__(self):
+        self._objective: Optional[Objective] = None
+        self._algo = "stochastic_gradient_descent"
+        self._max_iterations = 100
+        self._learning_rate = 0.1
+
+    def objective(self, f: Objective) -> "Solver":
+        self._objective = f
+        return self
+
+    def optimization_algo(self, name: str) -> "Solver":
+        self._algo = name.lower()
+        return self
+
+    def max_iterations(self, n: int) -> "Solver":
+        self._max_iterations = n
+        return self
+
+    def learning_rate(self, lr: float) -> "Solver":
+        self._learning_rate = lr
+        return self
+
+    def build(self) -> BaseOptimizer:
+        if self._objective is None:
+            raise ValueError("Solver needs an objective")
+        cls = OPTIMIZERS.get(self._algo)
+        if cls is None:
+            raise ValueError(f"Unknown algorithm '{self._algo}'. "
+                             f"Available: {sorted(OPTIMIZERS)}")
+        return cls(self._objective, self._max_iterations,
+                   learning_rate=self._learning_rate)

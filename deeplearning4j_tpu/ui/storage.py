@@ -1,0 +1,59 @@
+"""In-memory session-keyed storage for UI state.
+
+Parity with the reference `deeplearning4j-ui/.../storage/HistoryStorage` and
+`SessionStorage` (in-memory, session-keyed maps behind the REST resources).
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+
+class HistoryStorage:
+    """Ordered per-session event history (reference storage/HistoryStorage)."""
+
+    def __init__(self, max_items: int = 1000):
+        self._lock = threading.Lock()
+        self._data: Dict[str, List[Any]] = defaultdict(list)
+        self.max_items = max_items
+
+    def put(self, session_id: str, item: Any) -> None:
+        with self._lock:
+            items = self._data[session_id]
+            items.append(item)
+            if len(items) > self.max_items:
+                del items[: len(items) - self.max_items]
+
+    def get(self, session_id: str) -> List[Any]:
+        with self._lock:
+            return list(self._data.get(session_id, []))
+
+    def latest(self, session_id: str) -> Optional[Any]:
+        with self._lock:
+            items = self._data.get(session_id)
+            return items[-1] if items else None
+
+    def sessions(self) -> List[str]:
+        with self._lock:
+            return sorted(self._data.keys())
+
+
+class SessionStorage:
+    """Latest-value-per-key session store (reference storage/SessionStorage)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, Dict[str, Any]] = defaultdict(dict)
+
+    def put(self, session_id: str, key: str, value: Any) -> None:
+        with self._lock:
+            self._data[session_id][key] = value
+
+    def get(self, session_id: str, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._data.get(session_id, {}).get(key)
+
+    def sessions(self) -> List[str]:
+        with self._lock:
+            return sorted(self._data.keys())

@@ -1,0 +1,199 @@
+"""Clustering, t-SNE, classic optimizers, record readers.
+
+Mirrors the reference suites: clustering/kmeans tests, vptree tests,
+optimize/solver/TestOptimizers (Sphere/Rosenbrock/Rastrigin),
+BackTrackLineSearchTest, Canova ingestion tests (TestCanovaDataSetFunctions).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.trees import KDTree, VPTree
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne
+from deeplearning4j_tpu.optimize.solver import (BackTrackLineSearch,
+                                                ConjugateGradient, LBFGS,
+                                                LineGradientDescent, Solver,
+                                                StochasticGradientDescent)
+from deeplearning4j_tpu.datasets.records import (CSVRecordReader,
+                                                 CSVSequenceRecordReader,
+                                                 ListStringRecordReader,
+                                                 RecordReaderDataSetIterator,
+                                                 SequenceRecordReaderDataSetIterator)
+
+
+def _blobs(n_per=50, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0], [10, 10], [-10, 10]], np.float32)
+    pts = np.concatenate([c + rng.normal(0, 1, (n_per, 2)) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts.astype(np.float32), labels
+
+
+def test_kmeans():
+    pts, labels = _blobs()
+    cs = KMeansClustering.setup(3, max_iterations=50).apply_to(pts)
+    assert cs.num_clusters() == 3
+    # each true blob maps to exactly one cluster
+    for k in range(3):
+        assign = cs.assignments[labels == k]
+        assert len(np.unique(assign)) == 1
+    # nearest_cluster agrees with assignment
+    assert cs.nearest_cluster(pts[0]) == cs.assignments[0]
+
+
+def test_vptree_and_kdtree():
+    pts, _ = _blobs(20, seed=1)
+    vp = VPTree(pts, labels=[str(i) for i in range(len(pts))])
+    target = pts[7]
+    idx, dists = vp.search(target, k=3)
+    assert idx[0] == 7 and dists[0] == 0.0
+    # brute-force check
+    bf = np.argsort(np.linalg.norm(pts - target, axis=1))[:3]
+    assert set(idx) == set(bf.tolist())
+    assert vp.nearest_labels(target, 1) == ["7"]
+    kd = KDTree(pts)
+    i, d = kd.nn(target)
+    assert i == 7 and d == 0.0
+
+
+def test_tsne_separates_blobs():
+    pts, labels = _blobs(25, seed=2)
+    emb = Tsne(perplexity=10, max_iter=250, seed=3).fit_transform(pts)
+    assert emb.shape == (75, 2)
+    # within-cluster mean distance < across-cluster mean distance
+    within, across = [], []
+    for i in range(0, 75, 5):
+        for j in range(0, 75, 5):
+            if i == j:
+                continue
+            d = np.linalg.norm(emb[i] - emb[j])
+            (within if labels[i] == labels[j] else across).append(d)
+    assert np.mean(within) < 0.5 * np.mean(across)
+
+
+def test_barnes_hut_tsne_api():
+    pts, _ = _blobs(10, seed=4)
+    bh = BarnesHutTsne(theta=0.5, max_iter=50, perplexity=5)
+    emb = bh.fit_transform(pts)
+    assert emb.shape == (30, 2)
+    assert np.isfinite(bh.kl_)
+
+
+# -- optimizers on classic functions (reference TestOptimizers) ----------------
+
+def sphere(x):
+    return jnp.sum(x * x)
+
+
+def rosenbrock(x):
+    return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2)
+
+
+@pytest.mark.parametrize("opt_cls,max_it", [
+    (StochasticGradientDescent, 200),
+    (LineGradientDescent, 200),
+    (ConjugateGradient, 200),
+    (LBFGS, 100),
+])
+def test_optimizers_sphere(opt_cls, max_it):
+    x0 = np.asarray([3.0, -2.0, 1.5, 4.0])
+    opt = opt_cls(sphere, max_iterations=max_it, learning_rate=0.1)
+    x = opt.optimize(x0)
+    assert opt.score_ < 1e-4, f"{opt_cls.__name__}: {opt.score_}"
+
+
+@pytest.mark.parametrize("opt_cls,max_it,tol", [
+    (ConjugateGradient, 3000, 1e-2),
+    (LBFGS, 500, 1e-4),
+])
+def test_optimizers_rosenbrock(opt_cls, max_it, tol):
+    from deeplearning4j_tpu.optimize.solver import ZeroDirection
+    x0 = np.zeros(4)
+    opt = opt_cls(rosenbrock, max_iterations=max_it,
+                  terminations=[ZeroDirection()])
+    x = opt.optimize(x0)
+    assert opt.score_ < tol, f"{opt_cls.__name__}: {opt.score_}"
+    np.testing.assert_allclose(x, 1.0, atol=0.2)
+
+
+def test_backtrack_line_search():
+    ls = BackTrackLineSearch(sphere)
+    p = jnp.asarray([2.0, 2.0])
+    g = jnp.asarray([4.0, 4.0])
+    step = ls.optimize(p, g, -g)
+    assert 0 < step <= 1.0
+    # ascent direction -> rejected
+    assert ls.optimize(p, g, g) == 0.0
+
+
+def test_solver_builder():
+    opt = (Solver().objective(sphere).optimization_algo("lbfgs")
+           .max_iterations(50).build())
+    assert isinstance(opt, LBFGS)
+    with pytest.raises(ValueError, match="Unknown algorithm"):
+        Solver().objective(sphere).optimization_algo("quantum").build()
+
+
+# -- record readers ------------------------------------------------------------
+
+def test_csv_record_reader(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("# header\n1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n")
+    rr = CSVRecordReader(skip_lines=1).initialize(p)
+    it = RecordReaderDataSetIterator(rr, batch_size=2, num_classes=3)
+    ds = it.next_batch()
+    assert ds.features.shape == (2, 2)
+    assert ds.labels.shape == (2, 3)
+    np.testing.assert_array_equal(ds.labels[0], [1, 0, 0])
+    ds2 = it.next_batch()
+    assert ds2.num_examples() == 1
+    assert it.next_batch() is None
+    it.reset()
+    assert it.next_batch().num_examples() == 2
+
+
+def test_list_string_record_reader_regression():
+    rr = ListStringRecordReader().initialize([["1", "2", "0.5"], ["3", "4", "1.5"]])
+    it = RecordReaderDataSetIterator(rr, batch_size=10, regression=True)
+    ds = it.next_batch()
+    assert ds.labels.shape == (2, 1)
+    np.testing.assert_allclose(ds.labels.reshape(-1), [0.5, 1.5])
+
+
+def test_sequence_record_reader(tmp_path):
+    # ragged sequences: lengths 3 and 2 (reference csvsequence_*.txt style)
+    f0 = tmp_path / "f0.csv"
+    f0.write_text("1,2\n3,4\n5,6\n")
+    f1 = tmp_path / "f1.csv"
+    f1.write_text("7,8\n9,10\n")
+    l0 = tmp_path / "l0.csv"
+    l0.write_text("0\n1\n0\n")
+    l1 = tmp_path / "l1.csv"
+    l1.write_text("1\n1\n")
+    fr = CSVSequenceRecordReader().initialize([f0, f1])
+    lr = CSVSequenceRecordReader().initialize([l0, l1])
+    it = SequenceRecordReaderDataSetIterator(fr, lr, batch_size=2, num_classes=2)
+    ds = it.next_batch()
+    assert ds.features.shape == (2, 3, 2)
+    assert ds.labels.shape == (2, 3, 2)
+    np.testing.assert_array_equal(ds.features_mask, [[1, 1, 1], [1, 1, 0]])
+    np.testing.assert_array_equal(ds.labels[0, 1], [0, 1])
+    # padded step is zero
+    np.testing.assert_array_equal(ds.features[1, 2], [0, 0])
+
+
+def test_image_record_reader_npy(tmp_path):
+    from deeplearning4j_tpu.datasets.records import ImageRecordReader
+    (tmp_path / "cats").mkdir()
+    (tmp_path / "dogs").mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        np.save(tmp_path / "cats" / f"c{i}.npy", rng.random((4, 4, 1), np.float32).astype(np.float32))
+        np.save(tmp_path / "dogs" / f"d{i}.npy", rng.random((4, 4, 1)).astype(np.float32))
+    rr = ImageRecordReader(4, 4, 1).initialize(tmp_path)
+    it = RecordReaderDataSetIterator(rr, batch_size=6, num_classes=2)
+    ds = it.next_batch()
+    assert ds.features.shape == (6, 16)
+    assert ds.labels.shape == (6, 2)
+    assert ds.labels.sum() == 6

@@ -1,0 +1,122 @@
+"""UI server/listeners/components + CLI tests.
+
+Mirrors the reference UI smoke tests (ManualTests/TestRenders, ui-components
+serde tests) and cli/subcommands tests (TrainTest with dummy subcommands).
+"""
+import json
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration, Sgd
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+from deeplearning4j_tpu.ui.server import UiServer
+from deeplearning4j_tpu.ui.listeners import (FlowIterationListener,
+                                             HistogramIterationListener)
+from deeplearning4j_tpu.ui.components import (ChartHistogram, ChartLine,
+                                              ComponentTable, ComponentText,
+                                              DecoratorAccordion,
+                                              StaticPageUtil,
+                                              component_from_json,
+                                              component_to_json)
+from deeplearning4j_tpu.cli.main import main as cli_main
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1).updater(Sgd())
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_ui_server_roundtrip():
+    server = UiServer(port=0)
+    try:
+        ds = load_iris_dataset()
+        net = _net()
+        net.set_listeners(HistogramIterationListener(server.url(), "s1"),
+                          FlowIterationListener(server.url(), "s1"))
+        for _ in range(3):
+            net.fit(ds.features[:32], ds.labels[:32])
+        with urllib.request.urlopen(server.url() + "/weights/data?sid=s1") as r:
+            data = json.loads(r.read())
+        assert len(data) == 3
+        assert "score" in data[0] and "parameters" in data[0]
+        assert "0_W" in data[0]["parameters"]
+        with urllib.request.urlopen(server.url() + "/flow/data?sid=s1") as r:
+            flow = json.loads(r.read())
+        assert len(flow["layers"]) == 2
+        assert flow["layers"][1]["type"] == "OutputLayer"
+        with urllib.request.urlopen(server.url() + "/sessions") as r:
+            assert "s1" in json.loads(r.read())
+        with urllib.request.urlopen(server.url() + "/") as r:
+            assert b"dl4j-tpu" in r.read()
+    finally:
+        server.stop()
+
+
+def test_ui_components_serde_and_html(tmp_path):
+    line = ChartLine(title="loss").add_series("train", [0, 1, 2], [1.0, 0.5, 0.2])
+    hist = ChartHistogram(title="weights")
+    hist.add_bin(-1, 0, 5).add_bin(0, 1, 10)
+    table = ComponentTable(header=["metric", "value"],
+                           content=[["accuracy", "0.97"]])
+    acc = DecoratorAccordion(title="details",
+                             components=[ComponentText(text="hello")])
+    # serde round trip
+    restored = component_from_json(component_to_json(line))
+    assert restored.series_names == ["train"]
+    assert restored.y == [[1.0, 0.5, 0.2]]
+    html = StaticPageUtil.render_html([line, hist, table, acc,
+                                       ComponentText(text="done")])
+    assert "<svg" in html and "accuracy" in html and "details" in html
+    out = tmp_path / "report.html"
+    StaticPageUtil.save_html([line], out)
+    assert out.exists()
+
+
+@pytest.fixture
+def iris_csv(tmp_path):
+    ds = load_iris_dataset()
+    rows = []
+    for x, y in zip(ds.features, ds.labels):
+        rows.append(",".join(f"{v:.4f}" for v in x) + f",{int(np.argmax(y))}")
+    p = tmp_path / "iris.csv"
+    p.write_text("\n".join(rows) + "\n")
+    return p
+
+
+def test_cli_train_test_predict(tmp_path, iris_csv, capsys):
+    from deeplearning4j_tpu.models.zoo import mlp_iris
+    conf_path = tmp_path / "net.json"
+    conf_path.write_text(mlp_iris(lr=0.05).to_json())
+    model_path = tmp_path / "model.zip"
+
+    rc = cli_main(["train", "--conf", str(conf_path), "--input", str(iris_csv),
+                   "--output", str(model_path), "--epochs", "30",
+                   "--batch", "50", "--num-classes", "3"])
+    assert rc == 0
+    assert model_path.exists()
+    out = capsys.readouterr().out
+    assert "Model saved" in out
+
+    rc = cli_main(["test", "--model", str(model_path), "--input", str(iris_csv),
+                   "--num-classes", "3", "--batch", "50"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Accuracy" in out
+
+    preds_path = tmp_path / "preds.csv"
+    rc = cli_main(["predict", "--model", str(model_path), "--input", str(iris_csv),
+                   "--output", str(preds_path), "--num-classes", "3"])
+    assert rc == 0
+    preds = [int(l) for l in preds_path.read_text().splitlines()]
+    assert len(preds) == 150
+    assert set(preds) <= {0, 1, 2}
