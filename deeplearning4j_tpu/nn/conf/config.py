@@ -303,10 +303,11 @@ _CNN_LAYERS = (ConvolutionLayer, SubsamplingLayer, LocalResponseNormalization)
 
 def _layer_wants(layer: Layer) -> str:
     """What input kind a layer consumes."""
-    from .layers import (BaseRecurrentLayer, GlobalPoolingLayer, RnnOutputLayer)
+    from .layers import (BaseRecurrentLayer, GlobalPoolingLayer, RnnOutputLayer,
+                         SelfAttentionLayer)
     if isinstance(layer, _CNN_LAYERS):
         return "convolutional"
-    if isinstance(layer, (BaseRecurrentLayer, RnnOutputLayer)):
+    if isinstance(layer, (BaseRecurrentLayer, RnnOutputLayer, SelfAttentionLayer)):
         return "recurrent"
     if isinstance(layer, (ActivationLayer, DropoutLayer, BatchNormalization, GlobalPoolingLayer)):
         return "any"

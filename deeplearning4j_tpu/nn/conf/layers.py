@@ -284,6 +284,20 @@ class GlobalPoolingLayer(Layer):
         return input_type
 
 
+@register
+@dataclass
+class SelfAttentionLayer(FeedForwardLayer):
+    """Multi-head self-attention (no reference counterpart; long-context
+    capability — see nn/layers/attention.py). n_out must divide n_heads."""
+
+    n_heads: int = 4
+    causal: bool = False
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps if isinstance(input_type, RecurrentInputType) else None
+        return InputType.recurrent(self.n_out, ts)
+
+
 @dataclass
 class BasePretrainNetwork(FeedForwardLayer):
     loss: str = "reconstruction_crossentropy"

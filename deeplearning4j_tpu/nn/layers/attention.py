@@ -1,0 +1,57 @@
+"""Multi-head self-attention layer.
+
+No reference counterpart (pre-transformer codebase — SURVEY.md §5); added as
+the long-context-capable layer of this framework. Under a `pjit`/GSPMD mesh
+the dense path shards automatically; for explicit sequence parallelism use
+`parallel.ring.ring_attention` / `ulysses_attention` (same math, tested equal).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import LayerImpl, register_impl
+from .. import weights as winit
+from ...parallel.ring import full_attention
+
+Array = jax.Array
+
+
+@register_impl("SelfAttentionLayer")
+class SelfAttentionLayerImpl(LayerImpl):
+    WEIGHT_KEYS = ("Wq", "Wk", "Wv", "Wo")
+
+    def init_params(self, key, dtype=jnp.float32):
+        conf = self.conf
+        dist = conf.dist.spec() if getattr(conf, "dist", None) is not None else None
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        model = conf.n_out
+        mk = lambda k, i, o: winit.init_weights(k, (i, o), conf.weight_init or "xavier",
+                                                dist, dtype)
+        return {
+            "Wq": mk(kq, conf.n_in, model),
+            "Wk": mk(kk, conf.n_in, model),
+            "Wv": mk(kv, conf.n_in, model),
+            "Wo": mk(ko, model, model),
+            "b": jnp.full((model,), float(conf.bias_init or 0.0), dtype),
+        }
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        conf = self.conf
+        x = self._dropout(x, train, rng)
+        B, T, _ = x.shape
+        H = conf.n_heads
+        Dh = conf.n_out // H
+
+        def split(a):
+            return a.reshape(B, T, H, Dh)
+
+        q = split(jnp.einsum("btf,fo->bto", x, params["Wq"]))
+        k = split(jnp.einsum("btf,fo->bto", x, params["Wk"]))
+        v = split(jnp.einsum("btf,fo->bto", x, params["Wv"]))
+        o = full_attention(q, k, v, causal=conf.causal)
+        if mask is not None:
+            o = o * mask[:, :, None, None].astype(o.dtype)
+        out = jnp.einsum("btm,mn->btn", o.reshape(B, T, conf.n_out),
+                         params["Wo"]) + params["b"]
+        return self.activation_fn()(out), variables or {}

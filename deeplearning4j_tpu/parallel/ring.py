@@ -1,0 +1,150 @@
+"""Long-context attention: ring attention + Ulysses-style sequence parallelism.
+
+No reference counterpart (SURVEY.md §5 'Long-context: Absent' — the reference
+predates attention; its only length-scaling tool is truncated BPTT). These are
+the TPU-native long-context mechanisms required of this framework:
+
+  - `ring_attention(...)`: the sequence axis is sharded over the mesh's "seq"
+    devices; K/V blocks rotate around the ring via `lax.ppermute` while each
+    device keeps a streaming-softmax accumulator (running max / denominator /
+    weighted sum), so attention over a sequence of length L runs with O(L/n)
+    memory per device and compute overlapping the ICI transfers.
+    (Blockwise formulation per Liu et al., "Ring Attention with Blockwise
+    Transformers" — see PAPERS.md retrieval notes.)
+  - `ulysses_attention(...)`: all-to-all switches the sharding from sequence
+    to heads, runs ordinary full attention on H/n heads locally, and
+    all-to-alls back (DeepSpeed-Ulysses style sequence parallelism).
+
+Both are numerically equivalent to single-device full attention (tested on
+the 8-device CPU mesh against the dense reference implementation).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import SEQ_AXIS
+
+Array = jax.Array
+
+
+def full_attention(q: Array, k: Array, v: Array, causal: bool = False,
+                   scale: Optional[float] = None) -> Array:
+    """Dense reference attention. q,k,v: [B, L, H, D] -> [B, L, H, D]."""
+    D = q.shape[-1]
+    scale = scale or (1.0 / jnp.sqrt(D).astype(q.dtype))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        Lq, Lk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Lq, Lk), bool))
+        s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _block_attend(q, k, v, m, l, o, scale, q_off, k_off, causal):
+    """One streaming-softmax accumulation step.
+    q: [B, Lq, H, D]; k,v: [B, Lk, H, D]; m,l: [B, H, Lq]; o: [B, Lq, H, D]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Lq, Lk]
+    if causal:
+        Lq, Lk = q.shape[1], k.shape[1]
+        qpos = q_off + jnp.arange(Lq)
+        kpos = k_off + jnp.arange(Lk)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows (m_new could be -inf-like)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    corr = jnp.exp(m - m_safe)
+    p = jnp.exp(s - m_safe[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o_new = o * jnp.moveaxis(corr, 1, 2)[..., None] + pv
+    return m_safe, l_new, o_new
+
+
+def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh,
+                   axis: str = SEQ_AXIS, causal: bool = False) -> Array:
+    """Sequence-parallel attention over `mesh[axis]`.
+
+    q,k,v: GLOBAL [B, L, H, D] arrays (sharded or not — they are device_put
+    onto the sequence sharding); returns the global output with the same
+    sharding. L must be divisible by the axis size.
+    """
+    n = mesh.shape[axis]
+    B, L, H, D = q.shape
+    if L % n:
+        raise ValueError(f"sequence length {L} not divisible by {axis}={n}")
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    chunk = L // n
+
+    def local_fn(ql, kl, vl):
+        # ql/kl/vl: local [B, Lc, H, D]
+        idx = lax.axis_index(axis)
+        m = jnp.full((B, H, chunk), jnp.finfo(ql.dtype).min, ql.dtype)
+        l = jnp.zeros((B, H, chunk), ql.dtype)
+        o = jnp.zeros_like(ql)
+        perm = [(i, (i + 1) % n) for i in range(n)]  # send to next; recv from prev
+
+        def body(step, carry):
+            kc, vc, m, l, o = carry
+            # after `step` rotations this device holds the chunk that started
+            # on device (idx - step) mod n
+            src = jnp.mod(idx - step, n)
+            m, l, o = _block_attend(ql, kc, vc, m, l, o, scale,
+                                    idx * chunk, src * chunk, causal)
+            kc = lax.ppermute(kc, axis, perm)
+            vc = lax.ppermute(vc, axis, perm)
+            return kc, vc, m, l, o
+
+        _, _, m, l, o = lax.fori_loop(0, n, body, (kl, vl, m, l, o))
+        denom = jnp.moveaxis(jnp.maximum(l, 1e-20), 1, 2)[..., None]
+        return o / denom
+
+    spec = P(None, axis, None, None)
+    sharded = jax.jit(jax.shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
+    sh = NamedSharding(mesh, spec)
+    with mesh:
+        return sharded(jax.device_put(q, sh), jax.device_put(k, sh),
+                       jax.device_put(v, sh))
+
+
+def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh,
+                      axis: str = SEQ_AXIS, causal: bool = False) -> Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style): trade the
+    sequence sharding for a head sharding, attend fully per head, trade back.
+    Requires H divisible by the axis size."""
+    n = mesh.shape[axis]
+    B, L, H, D = q.shape
+    if H % n or L % n:
+        raise ValueError(f"heads {H} and length {L} must divide {axis}={n}")
+
+    def local_fn(ql, kl, vl):
+        def seq_to_head(x):
+            # [B, L/n, H, D] --all-to-all--> [B, L, H/n, D]
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+        def head_to_seq(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+        qh, kh, vh = seq_to_head(ql), seq_to_head(kl), seq_to_head(vl)
+        oh = full_attention(qh, kh, vh, causal=causal)
+        return head_to_seq(oh)
+
+    spec = P(None, axis, None, None)
+    sharded = jax.jit(jax.shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
+    sh = NamedSharding(mesh, spec)
+    with mesh:
+        return sharded(jax.device_put(q, sh), jax.device_put(k, sh),
+                       jax.device_put(v, sh))

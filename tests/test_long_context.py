@@ -1,0 +1,106 @@
+"""Long-context / sequence-parallel attention tests on the 8-device CPU mesh.
+
+No reference counterpart (SURVEY.md §5: long-context absent in the reference);
+the correctness bar is numerical equivalence with dense full attention.
+"""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.ring import (full_attention, ring_attention,
+                                              ulysses_attention)
+
+
+def _qkv(B=2, L=32, H=4, D=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.normal(size=(B, L, H, D)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    q, k, v = _qkv()
+    mesh = make_mesh({"seq": 8})
+    expected = np.asarray(full_attention(*map(jax.numpy.asarray, (q, k, v)),
+                                         causal=causal))
+    out = np.asarray(ring_attention(q, k, v, mesh, causal=causal))
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    q, k, v = _qkv(H=8)
+    mesh = make_mesh({"seq": 4})
+    expected = np.asarray(full_attention(*map(jax.numpy.asarray, (q, k, v)),
+                                         causal=causal))
+    out = np.asarray(ulysses_attention(q, k, v, mesh, causal=causal))
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_long_sequence_sharded_memory():
+    """L=512 over 8 devices: each device only ever holds L/8 keys."""
+    q, k, v = _qkv(B=1, L=512, H=2, D=4, seed=3)
+    mesh = make_mesh({"seq": 8})
+    out = ring_attention(q, k, v, mesh, causal=True)
+    expected = np.asarray(full_attention(*map(jax.numpy.asarray, (q, k, v)),
+                                         causal=True))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=3e-4, atol=3e-5)
+    # output keeps the sequence sharding
+    assert not out.sharding.is_fully_replicated
+
+
+def test_attention_layer_in_network():
+    """SelfAttentionLayer trains inside a MultiLayerNetwork."""
+    from deeplearning4j_tpu import (Adam, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import (GlobalPoolingLayer,
+                                                   OutputLayer,
+                                                   SelfAttentionLayer)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.01).updater(Adam())
+            .list()
+            .layer(SelfAttentionLayer(n_in=8, n_out=16, n_heads=4, causal=False,
+                                      activation="identity"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_in=16, n_out=2, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(5)
+    # task: does the sequence mean have positive first component?
+    x = rng.normal(size=(64, 10, 8)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.mean(axis=1)[:, 0] > 0).astype(int)]
+    s0 = net.score(x=x, y=y)
+    for _ in range(60):
+        net.fit(x, y)
+    assert net.score(x=x, y=y) < s0 * 0.6
+    out = np.asarray(net.output(x[:4]))
+    assert out.shape == (4, 2)
+
+
+def test_attention_gradcheck():
+    import jax as _jax
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration, Sgd
+    from deeplearning4j_tpu.nn.conf.layers import (OutputLayer,
+                                                   RnnOutputLayer,
+                                                   SelfAttentionLayer)
+    from deeplearning4j_tpu.util.gradientcheck import check_gradients
+    _jax.config.update("jax_enable_x64", True)
+    try:
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).dtype("float64").updater(Sgd())
+                .list()
+                .layer(SelfAttentionLayer(n_in=3, n_out=4, n_heads=2, causal=True,
+                                          activation="identity"))
+                .layer(RnnOutputLayer(n_in=4, n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 5, 3))
+        y = np.zeros((2, 5, 2))
+        y[:, :, 0] = 1
+        assert check_gradients(net, x, y, 1e-6, 1e-3)
+    finally:
+        _jax.config.update("jax_enable_x64", False)
