@@ -1,0 +1,28 @@
+"""CI coverage for the driver entry points (__graft_entry__.py).
+
+Round-1 verdict: the driver's multichip dryrun failed purely on bootstrap
+while the phases themselves passed — because nothing in CI exercised it.
+These tests run the real impl on the conftest-forced 8-device CPU mesh.
+"""
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import pytest
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
+
+
+def test_dryrun_multichip_inprocess():
+    # conftest forces an 8-device virtual CPU mesh, so the in-process
+    # path (no subprocess re-exec) is taken and all 3 phases must pass.
+    assert len(jax.devices()) >= 8
+    graft.dryrun_multichip(8)
