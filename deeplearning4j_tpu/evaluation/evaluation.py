@@ -56,6 +56,9 @@ class Evaluation:
             if mask is not None:
                 m = np.asarray(mask).reshape(-1) > 0
                 labels, predictions = labels[m], predictions[m]
+        elif mask is not None:  # [N, C] with a per-example mask
+            m = np.asarray(mask).reshape(-1) > 0
+            labels, predictions = labels[m], predictions[m]
         self._ensure(labels.shape[-1])
         actual = np.argmax(labels, axis=-1)
         guess = np.argmax(predictions, axis=-1)
@@ -150,6 +153,9 @@ class RegressionEvaluation:
             if mask is not None:
                 m = np.asarray(mask).reshape(-1) > 0
                 labels, predictions = labels[m], predictions[m]
+        elif mask is not None:  # [N, C] with a per-example mask
+            m = np.asarray(mask).reshape(-1) > 0
+            labels, predictions = labels[m], predictions[m]
         self._ensure(labels.shape[-1])
         err = labels - predictions
         self._sum_sq += (err ** 2).sum(axis=0)

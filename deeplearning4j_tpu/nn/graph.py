@@ -79,13 +79,12 @@ class ComputationGraph:
     # -- vertex forward --------------------------------------------------------
     def _vertex_forward(self, name: str, vertex: GraphVertex,
                         inputs: List[Array], params, variables, *,
-                        train, rng, fmasks, states, new_states):
+                        train, rng, mask, vmasks, states, new_states):
         if isinstance(vertex, LayerVertex):
             x = inputs[0]
             if vertex.preprocessor is not None:
                 x = vertex.preprocessor.preprocess(x)
             impl = self._impls[name]
-            mask = None  # per-vertex feature masks: use first input's mask
             if isinstance(impl, BaseRecurrentImpl):
                 state0 = (states or {}).get(name)
                 y, st = impl.forward_with_state(params[name], x, state0,
@@ -125,7 +124,7 @@ class ComputationGraph:
             return inputs[0] * vertex.scale_factor, None
         if isinstance(vertex, LastTimeStepVertex):
             x = inputs[0]
-            mask = (fmasks or {}).get(vertex.mask_input)
+            mask = vmasks.get(vertex.mask_input)
             if mask is None:
                 return x[:, -1, :], None
             idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
@@ -146,12 +145,18 @@ class ComputationGraph:
         conf = self.conf
         dtype = _dtype_of(conf.conf)
         acts: Dict[str, Array] = {}
+        # per-vertex feature-mask propagation (reference tracks masks through
+        # vertices via setLayerMaskArrays/feedForward(...,fMask,...)); a vertex
+        # inherits the first non-None mask of its inputs while the time axis
+        # survives, and drops it once time is collapsed (pooling/last-step).
+        vmasks: Dict[str, Optional[Array]] = {}
         self._current_timesteps = {}
         for i, iname in enumerate(conf.network_inputs):
             x = inputs[i]
             if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dtype:
                 x = x.astype(dtype)
             acts[iname] = x
+            vmasks[iname] = (fmasks or {}).get(iname)
             if x.ndim == 3:
                 self._current_timesteps[iname] = x.shape[1]
         new_vars = dict(variables)
@@ -162,14 +167,24 @@ class ComputationGraph:
         layer_rng = {name: rngs[i] for i, name in enumerate(sorted(self._impls))}
         for name in self.topo:
             vertex = conf.vertices[name]
-            vin = [acts[src] for src in conf.vertex_inputs[name]]
+            srcs = conf.vertex_inputs[name]
+            vin = [acts[src] for src in srcs]
+            src_masks = [m for m in (vmasks.get(s) for s in srcs)
+                         if m is not None]
+            in_mask = src_masks[0] if src_masks else None
+            for m in src_masks[1:]:  # multi-input: AND the masks together
+                in_mask = jnp.minimum(in_mask, m)
             y, nv = self._vertex_forward(
                 name, vertex, vin, params, variables,
-                train=train, rng=layer_rng.get(name), fmasks=fmasks,
-                states=states, new_states=new_states)
+                train=train, rng=layer_rng.get(name), mask=in_mask,
+                vmasks=vmasks, states=states, new_states=new_states)
             if nv is not None:
                 new_vars[name] = nv
             acts[name] = y
+            if isinstance(vertex, DuplicateToTimeSeriesVertex):
+                vmasks[name] = vmasks.get(vertex.reference_input)
+            else:
+                vmasks[name] = in_mask if getattr(y, "ndim", 0) == 3 else None
             if y.ndim == 3:
                 self._current_timesteps[name] = y.shape[1]
         return acts, new_vars, new_states
@@ -308,22 +323,32 @@ class ComputationGraph:
 
     # -- inference -------------------------------------------------------------
     def _get_forward(self, n_inputs: int):
+        # jit re-traces per fmask-presence pytree structure automatically
         key = ("fwd", n_inputs)
         if key not in self._jit_cache:
-            def fwd(params, variables, inputs):
+            def fwd(params, variables, inputs, fmasks_list):
+                fmask_dict = (dict(zip(self.conf.network_inputs, fmasks_list))
+                              if fmasks_list is not None else None)
                 acts, _, _ = self._forward_impl(params, variables, inputs,
-                                                train=False, rng=None)
+                                                train=False, rng=None,
+                                                fmasks=fmask_dict)
                 return [acts[name] for name in self.conf.network_outputs]
             self._jit_cache[key] = jax.jit(fwd)
         return self._jit_cache[key]
 
-    def output(self, *inputs, train: bool = False) -> List[Array]:
+    def output(self, *inputs, train: bool = False, fmasks=None) -> List[Array]:
         self._check_init()
         ins = [jnp.asarray(a) for a in inputs]
+        fl = ([jnp.asarray(m) if m is not None else None for m in fmasks]
+              if fmasks is not None else None)
         if not train:
-            return self._get_forward(len(ins))(self.params, self.variables, ins)
+            return self._get_forward(len(ins))(self.params, self.variables,
+                                               ins, fl)
+        self._key, rng = jax.random.split(self._key)  # train-mode stochastics
+        fmask_dict = (dict(zip(self.conf.network_inputs, fl))
+                      if fl is not None else None)
         acts, _, _ = self._forward_impl(self.params, self.variables, ins,
-                                        train=train, rng=None)
+                                        train=True, rng=rng, fmasks=fmask_dict)
         return [acts[name] for name in self.conf.network_outputs]
 
     def output_single(self, *inputs) -> Array:
@@ -431,7 +456,9 @@ class ComputationGraph:
         from ..evaluation.evaluation import Evaluation
         ev = Evaluation()
         for ds in iterator:
-            out = self.output_single(ds.features)
+            fm = getattr(ds, "features_mask", None)
+            out = self.output(ds.features,
+                              fmasks=[fm] if fm is not None else None)[0]
             ev.eval(ds.labels, out, mask=getattr(ds, "labels_mask", None))
         return ev
 

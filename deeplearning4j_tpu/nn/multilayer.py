@@ -346,18 +346,24 @@ class MultiLayerNetwork:
     def _get_forward(self, train: bool):
         key = ("fwd", train)
         if key not in self._jit_cache:
-            def fwd(params, variables, x, fmask):
-                acts, _, _ = self._forward_impl(params, variables, x, train=False,
-                                                rng=None, fmask=fmask)
+            def fwd(params, variables, x, fmask, rng):
+                acts, _, _ = self._forward_impl(params, variables, x, train=train,
+                                                rng=rng, fmask=fmask)
                 return acts[-1]
             self._jit_cache[key] = jax.jit(fwd)
         return self._jit_cache[key]
 
     def output(self, x, train: bool = False, fmask=None) -> Array:
-        """Network output (reference output:1502)."""
+        """Network output (reference output:1502). train=True applies
+        train-mode stochastics (dropout) with a fresh rng, matching the
+        reference's output(train) semantics."""
         self._check_init()
+        rng = None
+        if train:
+            self._key, rng = jax.random.split(self._key)
         return self._get_forward(train)(self.params, self.variables, jnp.asarray(x),
-                                        jnp.asarray(fmask) if fmask is not None else None)
+                                        jnp.asarray(fmask) if fmask is not None else None,
+                                        rng)
 
     def predict(self, x) -> np.ndarray:
         out = self.output(x)

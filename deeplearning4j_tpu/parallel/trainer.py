@@ -91,13 +91,24 @@ class IciDataParallelTrainingMaster(TrainingMaster):
                 y = np.asarray(ds.labels)
                 fm = getattr(ds, "features_mask", None)
                 lm = getattr(ds, "labels_mask", None)
-                if x.shape[0] % n_dev:  # pad (cyclically) to a divisible batch
-                    need = -(-x.shape[0] // n_dev) * n_dev
-                    idx = np.arange(need) % x.shape[0]
+                if x.shape[0] % n_dev:
+                    # Pad to a divisible batch with cyclic duplicates (keeps
+                    # BatchNorm batch statistics on-distribution) but give the
+                    # padded rows ZERO loss weight via the labels mask, so the
+                    # per-example mean is unbiased — the reference's
+                    # balancedRandomSplit never double-counts an example.
+                    orig = x.shape[0]
+                    need = -(-orig // n_dev) * n_dev
+                    idx = np.arange(need) % orig
                     x = x[idx]
                     y = y[idx]
                     fm = fm[idx] if fm is not None else None
-                    lm = lm[idx] if lm is not None else None
+                    if lm is None:
+                        lm_shape = (need,) if y.ndim == 2 else (need, y.shape[1])
+                        lm = np.ones(lm_shape, np.float32)
+                    else:
+                        lm = np.asarray(lm)[idx].astype(np.float32, copy=True)
+                    lm[orig:] = 0.0
                 xs = jax.device_put(jnp.asarray(x), shard)
                 ys = jax.device_put(jnp.asarray(y), shard)
                 fms = jax.device_put(jnp.asarray(fm), shard) if fm is not None else None
@@ -146,24 +157,26 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         raw_step = net._build_train_step((False, False, False))
         mesh = self.mesh
 
-        def worker_round(params, variables, ustates, step, rng, xs, ys):
-            # local views: [1, N, b, ...] -> scan over N minibatches
+        def worker_round(params, variables, ustates, step, rng, xs, ys, ls):
+            # local views: [1, N, b, ...] -> scan over N minibatches; ls is the
+            # per-example loss weight (zero on rows tiled in to fill the round)
             xs_l = xs[0]
             ys_l = ys[0]
+            ls_l = ls[0]
             widx = jax.lax.axis_index(DATA_AXIS)
             wrng = jax.random.fold_in(rng, widx)
 
             def body(carry, batch):
                 p, v, u, s = carry
-                x, y, i = batch
+                x, y, m, i = batch
                 srng = jax.random.fold_in(wrng, i)  # fresh dropout per local step
-                p, v, u, loss, _ = raw_step(p, v, u, s, srng, x, y, None, None, None)
+                p, v, u, loss, _ = raw_step(p, v, u, s, srng, x, y, None, m, None)
                 return (p, v, u, s + 1), loss
 
             n_local = xs_l.shape[0]
             (p, v, u, s), losses = jax.lax.scan(
                 body, (params, variables, ustates, step),
-                (xs_l, ys_l, jnp.arange(n_local)))
+                (xs_l, ys_l, ls_l, jnp.arange(n_local)))
             # parameter + updater-state averaging over the data axis
             # (reference processResults:352 aggregate-sum + divi, plus
             #  UpdaterAggregator for updater state)
@@ -178,7 +191,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         uspec = jax.tree_util.tree_map(lambda _: P(), net.updater_state)
         fn = jax.jit(jax.shard_map(
             worker_round, mesh=mesh,
-            in_specs=(pspec, vspec, uspec, P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=(pspec, vspec, uspec, P(), P(), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
             out_specs=(pspec, vspec, uspec, P()),
             check_vma=False,
         ))
@@ -202,22 +216,38 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             buf_x.clear()
             buf_y.clear()
             need = n_dev * n * b
-            if x.shape[0] < need:  # repeat tail to fill the round (static shapes)
-                reps = int(np.ceil(need / x.shape[0]))
+            orig = x.shape[0]
+            if orig < need:
+                # Partial round: mirror the reference's balancedRandomSplit —
+                # spread the real rows EVENLY over the workers (round-robin)
+                # so no worker idles, and zero-weight the fill rows so they
+                # contribute no gradient. Static shapes are preserved.
+                reps = int(np.ceil(need / orig))
                 x = np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:need]
                 y = np.tile(y, (reps,) + (1,) * (y.ndim - 1))[:need]
-            elif x.shape[0] > need:  # carry the remainder into the next round
+            elif orig > need:  # carry the remainder into the next round
                 buf_x.append(x[need:])
                 buf_y.append(y[need:])
-            xs = x[:need].reshape((n_dev, n, b) + x.shape[1:])
-            ys = y[:need].reshape((n_dev, n, b) + y.shape[1:])
+                x = x[:need]
+                y = y[:need]
+            lmask = np.ones((need,) if y.ndim == 2 else (need, y.shape[1]),
+                            np.float32)
+            lmask[min(orig, need):] = 0.0
+            if orig < need:
+                # row i -> worker i % n_dev: real rows land on every worker
+                perm = (np.arange(need).reshape(n * b, n_dev).T.reshape(-1))
+                x, y, lmask = x[perm], y[perm], lmask[perm]
+            xs = x.reshape((n_dev, n, b) + x.shape[1:])
+            ys = y.reshape((n_dev, n, b) + y.shape[1:])
+            ls = lmask.reshape((n_dev, n, b) + lmask.shape[1:])
             with phase_timer(self.stats, "aggregate_round"):
                 net._key, sub = jax.random.split(net._key)
                 with self.mesh:
                     (net.params, net.variables, net.updater_state,
                      loss) = round_fn(net.params, net.variables, net.updater_state,
                                       jnp.asarray(net.step), sub,
-                                      jnp.asarray(xs), jnp.asarray(ys))
+                                      jnp.asarray(xs), jnp.asarray(ys),
+                                      jnp.asarray(ls))
                 net.score_ = float(loss)
                 net.step += n
             for listener in net.listeners:

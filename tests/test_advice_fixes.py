@@ -1,0 +1,175 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. ComputationGraph must propagate feature masks to layer vertices.
+2. MultiLayerNetwork.output(train=True) must apply train-mode dropout.
+3. IciDataParallelTrainingMaster must not double-count padded rows.
+4. Evaluation / RegressionEvaluation must honor per-example masks on 2-D input.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ListDataSetIterator, MultiLayerNetwork,
+                               NeuralNetConfiguration, Sgd)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.evaluation.evaluation import (Evaluation,
+                                                      RegressionEvaluation)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, GlobalPoolingLayer,
+                                               GravesLSTM, OutputLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.parallel.mesh import default_mesh
+from deeplearning4j_tpu.parallel.trainer import (
+    IciDataParallelTrainingMaster, ParameterAveragingTrainingMaster)
+
+
+def test_graph_propagates_feature_mask_to_layers():
+    """A masked LSTM+pooling graph must match the equivalent
+    MultiLayerNetwork (which already propagates masks per-layer)."""
+    gconf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+             .updater(Sgd())
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("lstm", GravesLSTM(n_in=3, n_out=6, activation="tanh"),
+                        "in")
+             .add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "lstm")
+             .add_layer("out", OutputLayer(n_in=6, n_out=2, activation="softmax",
+                                           loss="negativeloglikelihood"), "pool")
+             .set_outputs("out")
+             .build())
+    g = ComputationGraph(gconf).init()
+    mconf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+             .updater(Sgd())
+             .list()
+             .layer(GravesLSTM(n_in=3, n_out=6, activation="tanh"))
+             .layer(GlobalPoolingLayer(pooling_type="avg"))
+             .layer(OutputLayer(n_in=6, n_out=2, activation="softmax",
+                                loss="negativeloglikelihood"))
+             .build())
+    mln = MultiLayerNetwork(mconf).init()
+    mln.set_params_flat(g.params_flat())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 5, 3)).astype(np.float32)
+    mask = np.ones((4, 5), np.float32)
+    mask[2, 3:] = 0.0
+    mask[3, 1:] = 0.0
+
+    out_m = np.asarray(mln.output(x, fmask=mask))
+    out_g = np.asarray(g.output(x, fmasks=[mask])[0])
+    np.testing.assert_allclose(out_g, out_m, rtol=1e-5, atol=1e-6)
+    # ... and the mask must actually change the result (it was silently
+    # dropped before the fix)
+    out_unmasked = np.asarray(g.output(x)[0])
+    assert not np.allclose(out_g, out_unmasked)
+
+
+def test_output_train_true_applies_dropout():
+    conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+            .updater(Sgd())
+            .list()
+            .layer(DenseLayer(n_in=10, n_out=32, activation="relu",
+                              dropout=0.5))
+            .layer(OutputLayer(n_in=32, n_out=4, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(1).normal(size=(16, 10)).astype(np.float32)
+    eval_a = np.asarray(net.output(x))
+    eval_b = np.asarray(net.output(x))
+    np.testing.assert_array_equal(eval_a, eval_b)  # inference: deterministic
+    train_a = np.asarray(net.output(x, train=True))
+    train_b = np.asarray(net.output(x, train=True))
+    assert not np.allclose(train_a, eval_a)   # dropout actually applied
+    assert not np.allclose(train_a, train_b)  # fresh rng per call
+
+
+def test_graph_output_train_true_applies_dropout():
+    gconf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+             .updater(Sgd())
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("dense", DenseLayer(n_in=10, n_out=32, activation="relu",
+                                            dropout=0.5), "in")
+             .add_layer("out", OutputLayer(n_in=32, n_out=4, activation="softmax",
+                                           loss="negativeloglikelihood"), "dense")
+             .set_outputs("out")
+             .build())
+    g = ComputationGraph(gconf).init()
+    x = np.random.default_rng(1).normal(size=(16, 10)).astype(np.float32)
+    eval_out = np.asarray(g.output(x)[0])
+    train_a = np.asarray(g.output(x, train=True)[0])
+    train_b = np.asarray(g.output(x, train=True)[0])
+    assert not np.allclose(train_a, eval_out)
+    assert not np.allclose(train_a, train_b)
+
+
+def _net(seed=12345, lr=0.1):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(Sgd())
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=10, activation="tanh"))
+            .layer(OutputLayer(n_in=10, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def test_ici_ragged_batch_not_double_counted():
+    """One ICI step on a ragged batch (6 rows over a 4-device mesh) must equal
+    one local SGD step on exactly those 6 rows — padded rows get zero loss
+    weight, so the per-example mean is unbiased."""
+    ds = _data(6, seed=11)
+    single = _net()
+    single.fit(ds.features, ds.labels)
+
+    dist = _net()
+    master = IciDataParallelTrainingMaster(mesh=default_mesh(4))
+    master.execute_training(dist, ListDataSetIterator(ds, 6, pad_last=False))
+    np.testing.assert_allclose(single.params_flat(), dist.params_flat(),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pa_partial_round_tiles_are_zero_weighted():
+    """A partial averaging round spreads the real rows round-robin over the
+    workers (balancedRandomSplit semantics) and zero-weights the fill: 12
+    examples over 2 workers x batch 8 equals the mean of two local fits on
+    the even and odd rows."""
+    ds = _data(12, seed=13)
+    manual = []
+    for sl in (slice(0, 12, 2), slice(1, 12, 2)):
+        net_w = _net()
+        net_w.fit(ds.features[sl], ds.labels[sl])
+        manual.append(net_w.params_flat())
+    expected = np.mean(manual, axis=0)
+
+    dist = _net()
+    master = ParameterAveragingTrainingMaster(
+        batch_size_per_worker=8, averaging_frequency=1, mesh=default_mesh(2))
+    master.execute_training(dist, ListDataSetIterator(ds, 12, pad_last=False))
+    np.testing.assert_allclose(dist.params_flat(), expected,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_evaluation_2d_mask():
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    preds = np.eye(3, dtype=np.float32)[[0, 1, 0, 1]]  # rows 2,3 wrong
+    mask = np.array([1.0, 1.0, 0.0, 0.0], np.float32)
+    ev = Evaluation()
+    ev.eval(labels, preds, mask=mask)
+    assert ev.accuracy() == 1.0
+    assert ev.confusion.matrix.sum() == 2
+
+
+def test_regression_evaluation_2d_mask():
+    labels = np.array([[1.0], [2.0], [100.0]], np.float32)
+    preds = np.array([[1.0], [2.0], [0.0]], np.float32)
+    mask = np.array([1.0, 1.0, 0.0], np.float32)
+    ev = RegressionEvaluation()
+    ev.eval(labels, preds, mask=mask)
+    assert ev.mean_squared_error(0) == pytest.approx(0.0, abs=1e-9)
