@@ -43,14 +43,14 @@ class ConvolutionLayerImpl(LayerImpl):
     def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
         x = self._dropout(x, train, rng)
         conf = self.conf
-        y = ophelpers.conv2d(
-            x, params["W"],
+        y = ophelpers.conv2d_bias_act(
+            x, params["W"], params["b"],
             stride=conf.stride,
             padding=_padding_config(conf),
             dilation=conf.dilation,
+            activation=conf.activation or "identity",
         )
-        y = y + params["b"]
-        return self.activation_fn()(y), variables or {}
+        return y, variables or {}
 
 
 @register_impl("SubsamplingLayer")

@@ -24,6 +24,7 @@ from jax import lax
 
 from .base import LayerImpl, register_impl
 from .. import weights as winit
+from ...ops import helpers as ophelpers
 
 Array = jax.Array
 State = Dict[str, Array]
@@ -89,23 +90,13 @@ class _LSTMCore(BaseRecurrentImpl):
         return {"h": jnp.zeros((batch, H), dtype), "c": jnp.zeros((batch, H), dtype)}
 
     def _gates(self, params, xproj_t, state):
-        """xproj_t: [B, 4H] (x·W + b precomputed); state: {h, c}."""
-        H = self.conf.n_out
-        act = self.activation_fn()
+        """xproj_t: [B, 4H] (x·W + b precomputed); state: {h, c}.
+        Cell math lives in ops/helpers.lstm_cell (single definition shared
+        with the lstm_sequence seam)."""
         z = xproj_t + state["h"] @ params["RW"]
-        zi, zf, zo, zg = z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H], z[:, 3 * H:]
-        c_prev = state["c"]
-        if self.PEEPHOLE:
-            zi = zi + c_prev * params["pI"]
-            zf = zf + c_prev * params["pF"]
-        i = jax.nn.sigmoid(zi)
-        f = jax.nn.sigmoid(zf)
-        g = act(zg)
-        c = f * c_prev + i * g
-        if self.PEEPHOLE:
-            zo = zo + c * params["pO"]
-        o = jax.nn.sigmoid(zo)
-        h = o * act(c)
+        peep = ((params["pI"], params["pF"], params["pO"]) if self.PEEPHOLE
+                else (0.0, 0.0, 0.0))
+        h, c = ophelpers.lstm_cell(z, state["c"], peep, self.activation_fn())
         return h, {"h": h, "c": c}
 
     def step(self, params, x_t, state):
@@ -124,20 +115,25 @@ class _LSTMCore(BaseRecurrentImpl):
         mask_t = (None if mask is None
                   else jnp.swapaxes(mask.astype(x.dtype), 0, 1)[..., None])  # [T, B, 1]
 
+        if mask_t is None:
+            # hot path: the whole sequence through the accelerated-helper
+            # seam (ops/helpers.lstm_sequence; Pallas override available)
+            H = self.conf.n_out
+            peep = (jnp.stack([params["pI"], params["pF"], params["pO"]])
+                    if self.PEEPHOLE else jnp.zeros((3, H), x.dtype))
+            ys, ht, ct = ophelpers.lstm_sequence(
+                xproj_t, params["RW"], peep, state0["h"], state0["c"],
+                activation=self.conf.activation or "identity", reverse=reverse)
+            return jnp.swapaxes(ys, 0, 1), {"h": ht, "c": ct}
+
         def body(state, inp):
             xp, m = inp
             h, new_state = self._gates(params, xp, state)
-            if m is not None:
-                new_state = self._mask_carry(new_state, state, m)
-                h = h * m
+            new_state = self._mask_carry(new_state, state, m)
+            h = h * m
             return new_state, h
 
-        inputs = (xproj_t, mask_t) if mask_t is not None else (xproj_t, None)
-        if mask_t is None:
-            final, ys = lax.scan(lambda s, xp: body(s, (xp, None)), state0, xproj_t,
-                                 reverse=reverse)
-        else:
-            final, ys = lax.scan(body, state0, (xproj_t, mask_t), reverse=reverse)
+        final, ys = lax.scan(body, state0, (xproj_t, mask_t), reverse=reverse)
         return jnp.swapaxes(ys, 0, 1), final  # [B, T, H]
 
 

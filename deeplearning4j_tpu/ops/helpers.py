@@ -59,6 +59,69 @@ def conv2d(x: Array, w: Array, *, stride=(1, 1), padding="SAME", dilation=(1, 1)
     return impl(x, w, stride=stride, padding=padding, dilation=dilation)
 
 
+# -- fused conv2d + bias + activation -----------------------------------------
+
+def _conv2d_bias_act_default(x, w, b, *, stride, padding, dilation, activation):
+    from . import activations
+    # route through the public conv2d seam so a 'conv2d' override still
+    # applies when no fused-op override is registered
+    y = conv2d(x, w, stride=stride, padding=padding, dilation=dilation)
+    return activations.get(activation)(y + b)
+
+
+def conv2d_bias_act(x: Array, w: Array, b: Array, *, stride=(1, 1),
+                    padding="SAME", dilation=(1, 1),
+                    activation="identity") -> Array:
+    """Fused NHWC conv + bias + activation — the cuDNN-helper hot path
+    (CudnnConvolutionHelper.java:48). Default: XLA fuses the epilogue into
+    the conv; Pallas override in ops/pallas_kernels.py."""
+    impl = _HELPERS.get("conv2d_bias_act", _conv2d_bias_act_default)
+    return impl(x, w, b, stride=stride, padding=padding, dilation=dilation,
+                activation=activation)
+
+
+# -- fused LSTM sequence -------------------------------------------------------
+
+def lstm_cell(z, c_prev, peep, act_fn):
+    """One LSTM cell step from pre-activations z = x·W + b + h·RW.
+    Gate packing [i, f, o, g]; peep = (pI, pF, pO) peephole weights (zeros/
+    scalars for a plain LSTM). THE single definition of the cell math —
+    shared by the scan default below and _LSTMCore._gates (masked path /
+    rnnTimeStep); the Pallas kernel mirrors it on padded shapes."""
+    H = c_prev.shape[-1]
+    i = jax.nn.sigmoid(z[..., :H] + c_prev * peep[0])
+    f = jax.nn.sigmoid(z[..., H:2 * H] + c_prev * peep[1])
+    g = act_fn(z[..., 3 * H:])
+    c = f * c_prev + i * g
+    o = jax.nn.sigmoid(z[..., 2 * H:3 * H] + c * peep[2])
+    h = o * act_fn(c)
+    return h, c
+
+
+def _lstm_sequence_default(xproj_t, rw, peep, h0, c0, *, activation, reverse):
+    from . import activations
+    act_fn = activations.get(activation)
+
+    def body(state, xp):
+        h_prev, c_prev = state
+        h, c = lstm_cell(xp + h_prev @ rw, c_prev, peep, act_fn)
+        return (h, c), h
+
+    (ht, ct), ys = lax.scan(body, (h0, c0), xproj_t, reverse=reverse)
+    return ys, ht, ct
+
+
+def lstm_sequence(xproj_t: Array, rw: Array, peep: Array, h0: Array, c0: Array,
+                  *, activation="tanh", reverse=False):
+    """Fused LSTM over a pre-projected sequence (the LSTMHelpers.java:132
+    hot loop). xproj_t: [T, B, 4H] = x·W + b for all timesteps; gate packing
+    [i, f, o, g]; peep: [3, H] peephole weights (zeros => plain LSTM).
+    Returns (ys [T, B, H], h_T, c_T)."""
+    impl = _HELPERS.get("lstm_sequence", _lstm_sequence_default)
+    return impl(xproj_t, rw, peep, h0, c0, activation=activation,
+                reverse=reverse)
+
+
 # -- pool2d --------------------------------------------------------------------
 
 def _pool2d_default(x: Array, *, kind, kernel, stride, padding, pnorm=2) -> Array:

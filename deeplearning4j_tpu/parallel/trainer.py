@@ -170,11 +170,21 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 p, v, u, s = carry
                 x, y, m, i = batch
                 srng = jax.random.fold_in(wrng, i)  # fresh dropout per local step
-                p, v, u, loss, _ = raw_step(p, v, u, s, srng, x, y, None, m, None)
-                return (p, v, u, s + 1), loss
+                np_, nv, nu, loss, _ = raw_step(p, v, u, s, srng, x, y, None, m, None)
+                # a minibatch that is 100% zero-weight fill must be a true
+                # no-op: stateful updaters (momentum/Adam) would otherwise
+                # move params and advance schedules on padding-only data
+                wsum = jnp.sum(m)
+                active = wsum > 0
+                sel = lambda a, b: jnp.where(active, a, b)  # noqa: E731
+                p = jax.tree_util.tree_map(sel, np_, p)
+                v = jax.tree_util.tree_map(sel, nv, v)
+                u = jax.tree_util.tree_map(sel, nu, u)
+                s = s + active.astype(s.dtype)
+                return (p, v, u, s), (loss, wsum)
 
             n_local = xs_l.shape[0]
-            (p, v, u, s), losses = jax.lax.scan(
+            (p, v, u, s), (losses, wsums) = jax.lax.scan(
                 body, (params, variables, ustates, step),
                 (xs_l, ys_l, ls_l, jnp.arange(n_local)))
             # parameter + updater-state averaging over the data axis
@@ -183,7 +193,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             p = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, DATA_AXIS), p)
             v = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, DATA_AXIS), v)
             u = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, DATA_AXIS), u)
-            loss = jax.lax.pmean(jnp.mean(losses), DATA_AXIS)
+            # example-weighted round loss: fill minibatches carry zero weight
+            loss_sum = jax.lax.psum(jnp.sum(losses * wsums), DATA_AXIS)
+            w_sum = jax.lax.psum(jnp.sum(wsums), DATA_AXIS)
+            loss = loss_sum / jnp.maximum(w_sum, 1.0)
             return p, v, u, loss
 
         pspec = jax.tree_util.tree_map(lambda _: P(), net.params)
