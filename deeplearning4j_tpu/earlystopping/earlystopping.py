@@ -200,24 +200,26 @@ class EarlyStoppingTrainer:
         self.net = net
         self.iterator = train_iterator
 
+    def _fit_epoch(self, result: EarlyStoppingResult) -> bool:
+        """One training epoch; returns True if an iteration-termination
+        condition fired. Overridden by the distributed trainer."""
+        cfg = self.config
+        for ds in self.iterator:
+            self.net.fit(ds)
+            for cond in cfg.iteration_termination_conditions:
+                if cond.terminate(self.net.score_):
+                    result.termination_reason = "IterationTerminationCondition"
+                    result.termination_details = type(cond).__name__
+                    return True
+        return False
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         result = EarlyStoppingResult()
         epoch = 0
         while True:
             self.iterator.reset()
-            terminated_iter = False
-            for ds in self.iterator:
-                self.net.fit(ds)
-                for cond in cfg.iteration_termination_conditions:
-                    if cond.terminate(self.net.score_):
-                        result.termination_reason = "IterationTerminationCondition"
-                        result.termination_details = type(cond).__name__
-                        terminated_iter = True
-                        break
-                if terminated_iter:
-                    break
-            if terminated_iter:
+            if self._fit_epoch(result):
                 break
             if epoch % cfg.evaluate_every_n_epochs == 0:
                 score = cfg.score_calculator.calculate_score(self.net)

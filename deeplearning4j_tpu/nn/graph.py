@@ -251,10 +251,12 @@ class ComputationGraph:
             new_ustates[name] = lu
         return new_params, new_ustates
 
-    def _get_train_step(self, key):
-        if key in self._jit_cache:
-            return self._jit_cache[key]
-        n_in, n_out, has_fmasks, has_lmasks = key
+    def _build_train_step(self):
+        """Raw (unjitted) pure train step — reused by the distributed
+        trainers (parallel/) inside shard_map, mirroring
+        MultiLayerNetwork._build_train_step. (jit retraces per input pytree
+        structure, so no shape key is needed here; _get_train_step's key is
+        purely a cache discriminator.)"""
 
         def loss_fn(params, variables, inputs, labels, fmasks, lmasks, rng):
             acts, new_vars, _ = self._forward_impl(params, variables, inputs,
@@ -270,7 +272,12 @@ class ComputationGraph:
             new_params, new_ustates = self._apply_updaters(params, grads, ustates, step)
             return new_params, new_vars, new_ustates, loss
 
-        fn = jax.jit(train_step, donate_argnums=(0, 2))
+        return train_step
+
+    def _get_train_step(self, key):
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        fn = jax.jit(self._build_train_step(), donate_argnums=(0, 2))
         self._jit_cache[key] = fn
         return fn
 

@@ -27,7 +27,6 @@ ICI within a slice, DCN across slices — no NCCL/MPI analog needed.
 """
 from __future__ import annotations
 
-import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -37,7 +36,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS, default_mesh
 from .stats import SparkTrainingStats, phase_timer
-from ..datasets.dataset import DataSet
 
 
 class TrainingMaster:
@@ -54,13 +52,82 @@ def _tree_put(tree, sharding):
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)
 
 
-def _require_multilayer(net):
-    from ..nn.multilayer import MultiLayerNetwork
-    if not isinstance(net, MultiLayerNetwork):
-        raise TypeError(
-            f"TrainingMaster implementations currently support MultiLayerNetwork "
-            f"only (got {type(net).__name__}); ComputationGraph distributed "
-            f"training is not yet wired")
+def _is_graph(net) -> bool:
+    from ..nn.graph import ComputationGraph
+    return isinstance(net, ComputationGraph)
+
+
+def _as_lists(ds):
+    """Normalize a DataSet/MultiDataSet to (inputs, labels, fmasks, lmasks)
+    lists — one entry per network input/output (reference MultiDataSet)."""
+    if hasattr(ds, "features_masks"):  # MultiDataSet
+        return (list(ds.features), list(ds.labels),
+                list(ds.features_masks) if ds.features_masks else None,
+                list(ds.labels_masks) if ds.labels_masks else None)
+    fm = getattr(ds, "features_mask", None)
+    lm = getattr(ds, "labels_mask", None)
+    return ([ds.features], [ds.labels],
+            [fm] if fm is not None else None,
+            [lm] if lm is not None else None)
+
+
+def _ones_lmask(y, need: int, orig: int) -> np.ndarray:
+    """Per-example loss weights: 1 for real rows, 0 for fill rows beyond
+    orig. Shape [need] for 2-D labels, [need, T] for time series."""
+    m = np.ones((need,) if y.ndim == 2 else (need, y.shape[1]), np.float32)
+    m[min(orig, need):] = 0.0
+    return m
+
+
+def _unified_step(net, has_fm: bool, has_lm: bool):
+    """A facade-independent pure train step
+    (params, variables, ustates, step, rng, inputs, labels, fmasks, lmasks)
+    -> (params, variables, ustates, loss) with list-typed inputs/labels/masks
+    — lets both masters drive MultiLayerNetwork AND ComputationGraph
+    (reference SparkDl4jMultiLayer + SparkComputationGraph.java:63,133)."""
+    if _is_graph(net):
+        raw = net._build_train_step()
+        in_names = net.conf.network_inputs
+
+        def step(p, v, u, s, rng, inputs, labels, fmasks, lmasks):
+            fmd = dict(zip(in_names, fmasks)) if fmasks is not None else None
+            return raw(p, v, u, s, rng, inputs, labels, fmd, lmasks)
+        return step
+
+    raw = net._build_train_step((has_fm, has_lm, False))
+
+    def step(p, v, u, s, rng, inputs, labels, fmasks, lmasks):
+        np_, nv, nu, loss, _ = raw(
+            p, v, u, s, rng, inputs[0], labels[0],
+            fmasks[0] if fmasks is not None else None,
+            lmasks[0] if lmasks is not None else None, None)
+        return np_, nv, nu, loss
+    return step
+
+
+def _pad_ragged(inputs, labels, fmasks, lmasks, n_dev):
+    """Pad batch axis to a multiple of n_dev with cyclic duplicates carrying
+    ZERO loss weight (see IciDataParallelTrainingMaster)."""
+    orig = inputs[0].shape[0]
+    if orig % n_dev == 0:
+        return inputs, labels, fmasks, lmasks
+    need = -(-orig // n_dev) * n_dev
+    idx = np.arange(need) % orig
+    inputs = [a[idx] for a in inputs]
+    labels = [a[idx] for a in labels]
+    if fmasks is not None:
+        fmasks = [np.asarray(m)[idx] if m is not None else None for m in fmasks]
+    if lmasks is None:
+        lmasks = [None] * len(labels)
+    out_lm = []
+    for y, m in zip(labels, lmasks):
+        if m is None:
+            m = _ones_lmask(y, need, orig)
+        else:
+            m = np.asarray(m)[idx].astype(np.float32, copy=True)
+            m[orig:] = 0.0
+        out_lm.append(m)
+    return inputs, labels, fmasks, out_lm
 
 
 class IciDataParallelTrainingMaster(TrainingMaster):
@@ -76,8 +143,14 @@ class IciDataParallelTrainingMaster(TrainingMaster):
         self.mesh = mesh or default_mesh()
         self.stats = SparkTrainingStats() if collect_stats else None
 
+    def _get_step(self, net, has_fm: bool, has_lm: bool):
+        key = ("ici_step", has_fm, has_lm)
+        if key not in net._jit_cache:
+            net._jit_cache[key] = jax.jit(_unified_step(net, has_fm, has_lm),
+                                          donate_argnums=(0, 2))
+        return net._jit_cache[key]
+
     def execute_training(self, net, iterator) -> None:
-        _require_multilayer(net)
         net._check_init()
         repl = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
@@ -87,38 +160,29 @@ class IciDataParallelTrainingMaster(TrainingMaster):
         n_dev = self.mesh.size
         for ds in iterator:
             with phase_timer(self.stats, "data_fetch"):
-                x = np.asarray(ds.features)
-                y = np.asarray(ds.labels)
-                fm = getattr(ds, "features_mask", None)
-                lm = getattr(ds, "labels_mask", None)
-                if x.shape[0] % n_dev:
-                    # Pad to a divisible batch with cyclic duplicates (keeps
-                    # BatchNorm batch statistics on-distribution) but give the
-                    # padded rows ZERO loss weight via the labels mask, so the
-                    # per-example mean is unbiased — the reference's
-                    # balancedRandomSplit never double-counts an example.
-                    orig = x.shape[0]
-                    need = -(-orig // n_dev) * n_dev
-                    idx = np.arange(need) % orig
-                    x = x[idx]
-                    y = y[idx]
-                    fm = fm[idx] if fm is not None else None
-                    if lm is None:
-                        lm_shape = (need,) if y.ndim == 2 else (need, y.shape[1])
-                        lm = np.ones(lm_shape, np.float32)
-                    else:
-                        lm = np.asarray(lm)[idx].astype(np.float32, copy=True)
-                    lm[orig:] = 0.0
-                xs = jax.device_put(jnp.asarray(x), shard)
-                ys = jax.device_put(jnp.asarray(y), shard)
-                fms = jax.device_put(jnp.asarray(fm), shard) if fm is not None else None
-                lms = jax.device_put(jnp.asarray(lm), shard) if lm is not None else None
+                inputs, labels, fms, lms = _as_lists(ds)
+                inputs = [np.asarray(a) for a in inputs]
+                labels = [np.asarray(a) for a in labels]
+                # Ragged batches: pad with cyclic duplicates (keeps BatchNorm
+                # batch statistics on-distribution) carrying ZERO loss weight,
+                # so the per-example mean is unbiased — the reference's
+                # balancedRandomSplit never double-counts an example.
+                inputs, labels, fms, lms = _pad_ragged(inputs, labels,
+                                                       fms, lms, n_dev)
+
+                def put(a):
+                    return (jax.device_put(jnp.asarray(a), shard)
+                            if a is not None else None)
+                xs = [put(a) for a in inputs]
+                ys = [put(a) for a in labels]
+                fmss = [put(m) for m in fms] if fms is not None else None
+                lmss = [put(m) for m in lms] if lms is not None else None
             with phase_timer(self.stats, "process_minibatch"):
-                step_fn = net._get_train_step((fms is not None, lms is not None, False))
+                step_fn = self._get_step(net, fmss is not None, lmss is not None)
                 net._key, sub = jax.random.split(net._key)
-                (net.params, net.variables, net.updater_state, loss,
-                 _) = step_fn(net.params, net.variables, net.updater_state,
-                              jnp.asarray(net.step), sub, xs, ys, fms, lms, None)
+                (net.params, net.variables, net.updater_state,
+                 loss) = step_fn(net.params, net.variables, net.updater_state,
+                                 jnp.asarray(net.step), sub, xs, ys, fmss, lmss)
                 net.score_ = float(loss)
                 net.step += 1
             for listener in net.listeners:
@@ -147,34 +211,37 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.stats = SparkTrainingStats() if collect_stats else None
 
     # -- the shard_map'd worker round ------------------------------------------
-    def _get_round_fn(self, net):
-        _require_multilayer(net)
+    def _get_round_fn(self, net, has_fm: bool):
         # cache on the net itself so the compiled round's lifetime (and its
         # closure over the net's layers) is tied to that net
-        key = ("pa_round", self.averaging_frequency, self.mesh.shape_tuple)
+        key = ("pa_round", self.averaging_frequency, self.mesh.shape_tuple,
+               has_fm)
         if key in net._jit_cache:
             return net._jit_cache[key]
-        raw_step = net._build_train_step((False, False, False))
+        raw_step = _unified_step(net, has_fm, True)
         mesh = self.mesh
 
-        def worker_round(params, variables, ustates, step, rng, xs, ys, ls):
-            # local views: [1, N, b, ...] -> scan over N minibatches; ls is the
-            # per-example loss weight (zero on rows tiled in to fill the round)
-            xs_l = xs[0]
-            ys_l = ys[0]
-            ls_l = ls[0]
+        def worker_round(params, variables, ustates, step, rng, xs, ys, fs, ls):
+            # local views: lists of [1, N, b, ...] -> scan over N minibatches;
+            # fs carries feature masks (or None), ls the per-example loss
+            # weights (zero on rows tiled in to fill the round)
+            xs_l = [a[0] for a in xs]
+            ys_l = [a[0] for a in ys]
+            fs_l = ([f[0] if f is not None else None for f in fs]
+                    if fs is not None else None)
+            ls_l = [m[0] for m in ls]
             widx = jax.lax.axis_index(DATA_AXIS)
             wrng = jax.random.fold_in(rng, widx)
 
             def body(carry, batch):
                 p, v, u, s = carry
-                x, y, m, i = batch
+                x, y, f, m, i = batch
                 srng = jax.random.fold_in(wrng, i)  # fresh dropout per local step
-                np_, nv, nu, loss, _ = raw_step(p, v, u, s, srng, x, y, None, m, None)
+                np_, nv, nu, loss = raw_step(p, v, u, s, srng, x, y, f, m)
                 # a minibatch that is 100% zero-weight fill must be a true
                 # no-op: stateful updaters (momentum/Adam) would otherwise
                 # move params and advance schedules on padding-only data
-                wsum = jnp.sum(m)
+                wsum = sum(jnp.sum(mm) for mm in m)
                 active = wsum > 0
                 sel = lambda a, b: jnp.where(active, a, b)  # noqa: E731
                 p = jax.tree_util.tree_map(sel, np_, p)
@@ -183,10 +250,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 s = s + active.astype(s.dtype)
                 return (p, v, u, s), (loss, wsum)
 
-            n_local = xs_l.shape[0]
+            n_local = xs_l[0].shape[0]
             (p, v, u, s), (losses, wsums) = jax.lax.scan(
                 body, (params, variables, ustates, step),
-                (xs_l, ys_l, ls_l, jnp.arange(n_local)))
+                (xs_l, ys_l, fs_l, ls_l, jnp.arange(n_local)))
             # parameter + updater-state averaging over the data axis
             # (reference processResults:352 aggregate-sum + divi, plus
             #  UpdaterAggregator for updater state)
@@ -199,14 +266,11 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             loss = loss_sum / jnp.maximum(w_sum, 1.0)
             return p, v, u, loss
 
-        pspec = jax.tree_util.tree_map(lambda _: P(), net.params)
-        vspec = jax.tree_util.tree_map(lambda _: P(), net.variables)
-        uspec = jax.tree_util.tree_map(lambda _: P(), net.updater_state)
         fn = jax.jit(jax.shard_map(
             worker_round, mesh=mesh,
-            in_specs=(pspec, vspec, uspec, P(), P(), P(DATA_AXIS), P(DATA_AXIS),
-                      P(DATA_AXIS)),
-            out_specs=(pspec, vspec, uspec, P()),
+            in_specs=(P(), P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(), P(), P()),
             check_vma=False,
         ))
         net._jit_cache[key] = fn
@@ -217,50 +281,101 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         n_dev = self.mesh.size
         b = self.batch_size_per_worker
         n = self.averaging_frequency
-        round_fn = self._get_round_fn(net)
-        buf_x: List[np.ndarray] = []
-        buf_y: List[np.ndarray] = []
+        # (inputs, labels, fmasks-or-None, lmasks-or-None) per fetched batch
+        buf: List[tuple] = []
+
+        def have():
+            return sum(t[0][0].shape[0] for t in buf)
+
+        def _concat_masks(pos: int, batches, ref_col):
+            """Concatenate per-batch masks for one input/output position,
+            substituting ones for batches that carry no mask. Returns None if
+            NO batch carries a mask at this position."""
+            present = [t[pos][ref_col] for t in batches
+                       if t[pos] is not None and t[pos][ref_col] is not None]
+            if not present:
+                return None
+            template = np.asarray(present[0])
+            out = []
+            for t in batches:
+                m = t[pos][ref_col] if t[pos] is not None else None
+                nrows = t[0][0].shape[0]
+                if m is None:
+                    m = np.ones((nrows,) + template.shape[1:], np.float32)
+                out.append(np.asarray(m, np.float32))
+            return np.concatenate(out)
 
         def flush():
-            if not buf_x:
+            if not buf:
                 return
-            x = np.concatenate(buf_x)
-            y = np.concatenate(buf_y)
-            buf_x.clear()
-            buf_y.clear()
+            n_in = len(buf[0][0])
+            n_out = len(buf[0][1])
+            batches = list(buf)
+            buf.clear()
+            inputs = [np.concatenate([t[0][k] for t in batches])
+                      for k in range(n_in)]
+            labels = [np.concatenate([t[1][k] for t in batches])
+                      for k in range(n_out)]
+            fms = [_concat_masks(2, batches, k) for k in range(n_in)]
+            has_fm = any(m is not None for m in fms)
+            lms = [_concat_masks(3, batches, k) for k in range(n_out)]
             need = n_dev * n * b
-            orig = x.shape[0]
-            if orig < need:
+            orig = inputs[0].shape[0]
+
+            def fill(a):
                 # Partial round: mirror the reference's balancedRandomSplit —
-                # spread the real rows EVENLY over the workers (round-robin)
-                # so no worker idles, and zero-weight the fill rows so they
-                # contribute no gradient. Static shapes are preserved.
+                # fill rows are cyclic duplicates, later zero-weighted and
+                # spread round-robin so no worker idles.
                 reps = int(np.ceil(need / orig))
-                x = np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:need]
-                y = np.tile(y, (reps,) + (1,) * (y.ndim - 1))[:need]
+                return np.tile(a, (reps,) + (1,) * (a.ndim - 1))[:need]
+
+            if orig < need:
+                inputs = [fill(a) for a in inputs]
+                labels = [fill(a) for a in labels]
+                fms = [fill(m) if m is not None else None for m in fms]
+                lms = [fill(m) if m is not None else None for m in lms]
             elif orig > need:  # carry the remainder into the next round
-                buf_x.append(x[need:])
-                buf_y.append(y[need:])
-                x = x[:need]
-                y = y[:need]
-            lmask = np.ones((need,) if y.ndim == 2 else (need, y.shape[1]),
-                            np.float32)
-            lmask[min(orig, need):] = 0.0
+                buf.append(([a[need:] for a in inputs],
+                            [a[need:] for a in labels],
+                            [m[need:] if m is not None else None for m in fms]
+                            if has_fm else None,
+                            [m[need:] if m is not None else None for m in lms]
+                            if any(m is not None for m in lms) else None))
+                inputs = [a[:need] for a in inputs]
+                labels = [a[:need] for a in labels]
+                fms = [m[:need] if m is not None else None for m in fms]
+                lms = [m[:need] if m is not None else None for m in lms]
+            # loss weights: real labels mask (or ones) with zero fill rows
+            lmasks = []
+            for y, m in zip(labels, lms):
+                w = _ones_lmask(y, need, orig)
+                if m is not None:
+                    w = w * np.asarray(m, np.float32).reshape(w.shape)
+                lmasks.append(w)
             if orig < need:
                 # row i -> worker i % n_dev: real rows land on every worker
                 perm = (np.arange(need).reshape(n * b, n_dev).T.reshape(-1))
-                x, y, lmask = x[perm], y[perm], lmask[perm]
-            xs = x.reshape((n_dev, n, b) + x.shape[1:])
-            ys = y.reshape((n_dev, n, b) + y.shape[1:])
-            ls = lmask.reshape((n_dev, n, b) + lmask.shape[1:])
+                inputs = [a[perm] for a in inputs]
+                labels = [a[perm] for a in labels]
+                lmasks = [m[perm] for m in lmasks]
+                fms = [m[perm] if m is not None else None for m in fms]
+
+            def stack(a):
+                return jnp.asarray(a.reshape((n_dev, n, b) + a.shape[1:]))
+            xs = [stack(a) for a in inputs]
+            ys = [stack(a) for a in labels]
+            ls = [stack(m) for m in lmasks]
+            fs = ([stack(m) if m is not None else None for m in fms]
+                  if has_fm else None)
+            round_fn = self._get_round_fn(net, has_fm)
             with phase_timer(self.stats, "aggregate_round"):
                 net._key, sub = jax.random.split(net._key)
                 with self.mesh:
                     (net.params, net.variables, net.updater_state,
-                     loss) = round_fn(net.params, net.variables, net.updater_state,
+                     loss) = round_fn(net.params, net.variables,
+                                      net.updater_state,
                                       jnp.asarray(net.step), sub,
-                                      jnp.asarray(xs), jnp.asarray(ys),
-                                      jnp.asarray(ls))
+                                      xs, ys, fs, ls)
                 net.score_ = float(loss)
                 net.step += n
             for listener in net.listeners:
@@ -269,12 +384,13 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         with phase_timer(self.stats, "total_training"):
             for ds in iterator:
                 with phase_timer(self.stats, "data_fetch"):
-                    buf_x.append(np.asarray(ds.features))
-                    buf_y.append(np.asarray(ds.labels))
-                have = sum(a.shape[0] for a in buf_x)
-                if have >= n_dev * n * b:
+                    inputs, labels, bfm, blm = _as_lists(ds)
+                    buf.append(([np.asarray(a) for a in inputs],
+                                [np.asarray(a) for a in labels],
+                                bfm, blm))
+                if have() >= n_dev * n * b:
                     flush()
-            while buf_x:
+            while buf:
                 flush()
 
     def get_training_stats(self):
