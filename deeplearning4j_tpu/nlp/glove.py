@@ -57,11 +57,11 @@ class Glove(SequenceVectors):
 
     def __init__(self, layer_size=50, window=15, min_word_frequency=1,
                  learning_rate=0.05, epochs=25, batch_size=4096, seed=42,
-                 x_max=100.0, alpha=0.75, symmetric=True):
+                 x_max=100.0, alpha=0.75, symmetric=True, mesh=None):
         super().__init__(layer_size=layer_size, window=window,
                          min_word_frequency=min_word_frequency,
                          learning_rate=learning_rate, epochs=epochs,
-                         batch_size=batch_size, seed=seed)
+                         batch_size=batch_size, seed=seed, mesh=mesh)
         self.x_max = x_max
         self.alpha = alpha
         self.symmetric = symmetric
@@ -74,7 +74,7 @@ class Glove(SequenceVectors):
                    "learning_rate": "learning_rate", "epochs": "epochs",
                    "iterations": "epochs", "batch_size": "batch_size",
                    "seed": "seed", "x_max": "x_max", "alpha": "alpha",
-                   "symmetric": "symmetric"}
+                   "symmetric": "symmetric", "use_mesh": "mesh"}
 
     @staticmethod
     def builder() -> "Glove.Builder":
@@ -91,10 +91,11 @@ class Glove(SequenceVectors):
         rows, cols, vals = cooc.triples()
         V, D = self.vocab.num_words(), self.layer_size
         rng = np.random.default_rng(self.seed)
-        w = jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D)
-        wc = jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D)
-        b = jnp.zeros((V,), jnp.float32)
-        bc = jnp.zeros((V,), jnp.float32)
+        put_b, put_r = self._placers()  # mesh: batch sharded, tables replicated
+        w = put_r(jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D))
+        wc = put_r(jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D))
+        b = put_r(jnp.zeros((V,), jnp.float32))
+        bc = put_r(jnp.zeros((V,), jnp.float32))
         # AdaGrad accumulators (reference uses per-row AdaGrad)
         hw, hwc = jnp.ones_like(w), jnp.ones_like(wc)
         hb, hbc = jnp.ones_like(b), jnp.ones_like(bc)
@@ -138,8 +139,8 @@ class Glove(SequenceVectors):
                 valid[:nv] = 1.0
                 (w, wc, b, bc, hw, hwc, hb, hbc, loss) = step(
                     w, wc, b, bc, hw, hwc, hb, hbc,
-                    jnp.asarray(i), jnp.asarray(j), jnp.asarray(x),
-                    jnp.asarray(valid), np.float32(self.learning_rate))
+                    put_b(i), put_b(j), put_b(x),
+                    put_b(valid), np.float32(self.learning_rate))
                 last = float(loss)
         # final embedding = w + wc (GloVe convention)
         from .word2vec import InMemoryLookupTable

@@ -65,7 +65,7 @@ class SequenceVectors:
     def __init__(self, layer_size=100, window=5, min_word_frequency=1,
                  negative=5, use_hierarchic_softmax=False, learning_rate=0.025,
                  min_learning_rate=1e-4, epochs=1, batch_size=2048, seed=42,
-                 subsample=0.0, cbow=False, grad_clip=1.0):
+                 subsample=0.0, cbow=False, grad_clip=1.0, mesh=None):
         self.layer_size = layer_size
         self.window = window
         self.min_word_frequency = min_word_frequency
@@ -82,10 +82,19 @@ class SequenceVectors:
         # single row can receive when it recurs many times in one batch (the
         # sequential reference bounds this naturally by updating incrementally)
         self.grad_clip = grad_clip
+        # Distributed training (reference dl4j-spark-nlp
+        # spark/.../embeddings/word2vec/Word2Vec.java:134): pass a
+        # jax.sharding.Mesh and each pair batch is sharded over its "data"
+        # axis with the tables replicated — the dense batched gradients are
+        # all-reduced by ONE psum GSPMD inserts per step, replacing the
+        # Spark mapPartitions + vector-averaging round trip. The math equals
+        # the single-device batched step on the same global batch.
+        self.mesh = mesh
         self.vocab: Optional[VocabCache] = None
         self.lookup_table: Optional[InMemoryLookupTable] = None
         self._unigram_table: Optional[np.ndarray] = None
         self._max_code_len = 0
+        self.words_per_sec_ = float("nan")
 
     # -- data ------------------------------------------------------------------
     def _build_vocab(self, sequences: List[List[str]]):
@@ -241,12 +250,38 @@ class SequenceVectors:
 
         return step
 
+    # -- sharding helpers ------------------------------------------------------
+    def _placers(self):
+        """(put_batch, put_repl): device-placement fns for batch arrays and
+        the weight tables. With a mesh: batch sharded over "data", tables
+        replicated (GSPMD all-reduces the gradients over ICI)."""
+        if self.mesh is None:
+            return jnp.asarray, lambda a: a
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import DATA_AXIS
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        repl = NamedSharding(self.mesh, P())
+        return (lambda a: jax.device_put(jnp.asarray(a), shard),
+                lambda a: jax.device_put(a, repl))
+
     # -- training --------------------------------------------------------------
     def fit_sequences(self, sequences: List[List[str]]):
+        import time as _time
         self._build_vocab(sequences)
         encoded = self._encode(sequences)
         rng = np.random.default_rng(self.seed)
         table = self.lookup_table
+        put_b, put_r = self._placers()
+        if self.mesh is not None:
+            if self.batch_size % self.mesh.size:
+                raise ValueError(
+                    f"batch_size {self.batch_size} must divide the mesh size "
+                    f"{self.mesh.size}")
+            table.syn0 = put_r(table.syn0)
+            if table.syn1 is not None:
+                table.syn1 = put_r(table.syn1)
+            if table.syn1neg is not None:
+                table.syn1neg = put_r(table.syn1neg)
         step_neg = self._make_neg_step() if self.negative > 0 else None
         step_hs = self._make_hs_step() if self.use_hs else None
         if self.use_hs:
@@ -260,9 +295,6 @@ class SequenceVectors:
                 points_tbl[vw.index, :L] = vw.points
                 codes_tbl[vw.index, :L] = vw.codes
                 mask_tbl[vw.index, :L] = 1.0
-            points_tbl = jnp.asarray(points_tbl)
-            codes_tbl = jnp.asarray(codes_tbl)
-            mask_tbl = jnp.asarray(mask_tbl)
 
         # total pair estimate for linear lr decay (word2vec convention)
         total_pairs = max(1, sum(max(len(s) - 1, 0) for s in encoded)
@@ -276,9 +308,12 @@ class SequenceVectors:
         seen = 0
         B = self.batch_size
         last_loss = float("nan")
+        tokens_seen = 0
+        t0 = _time.perf_counter()
         for _ in range(self.epochs):
             order = rng.permutation(len(encoded))
             epoch_seqs = self._subsample([encoded[i] for i in order], rng)
+            tokens_seen += sum(len(s) for s in epoch_seqs)
             if self.cbow:
                 centers, ctxs, cmasks = self._cbow_batches(epoch_seqs, rng)
                 for off in range(0, centers.size, B):
@@ -299,9 +334,9 @@ class SequenceVectors:
                                       size=(B, self.negative), p=self._neg_probs
                                       ).astype(np.int32)
                     table.syn0, table.syn1neg, loss = step_cbow(
-                        table.syn0, table.syn1neg, jnp.asarray(c),
-                        jnp.asarray(cx), jnp.asarray(cm), jnp.asarray(negs),
-                        jnp.asarray(valid), lr)
+                        table.syn0, table.syn1neg, put_b(c),
+                        put_b(cx), put_b(cm), put_b(negs),
+                        put_b(valid), lr)
                     last_loss = float(loss)
                     seen += nv
                 continue
@@ -327,15 +362,18 @@ class SequenceVectors:
                                       size=(B, self.negative), p=self._neg_probs
                                       ).astype(np.int32)
                     table.syn0, table.syn1neg, loss = step_neg(
-                        table.syn0, table.syn1neg, jnp.asarray(c), jnp.asarray(t),
-                        jnp.asarray(negs), jnp.asarray(valid), lr)
+                        table.syn0, table.syn1neg, put_b(c), put_b(t),
+                        put_b(negs), put_b(valid), lr)
                 if self.use_hs:
                     table.syn0, table.syn1, loss = step_hs(
-                        table.syn0, table.syn1, jnp.asarray(c),
-                        points_tbl[t], codes_tbl[t], mask_tbl[t],
-                        jnp.asarray(valid), lr)
+                        table.syn0, table.syn1, put_b(c),
+                        put_b(points_tbl[t]), put_b(codes_tbl[t]),
+                        put_b(mask_tbl[t]), put_b(valid), lr)
                 last_loss = float(loss)
                 seen += nvalid
+        jax.block_until_ready(table.syn0)
+        elapsed = max(_time.perf_counter() - t0, 1e-9)
+        self.words_per_sec_ = tokens_seen / elapsed
         self.score_ = last_loss
         return self
 
@@ -433,7 +471,8 @@ class Word2Vec(SequenceVectors):
                        min_learning_rate="min_learning_rate",
                        sampling="subsample",
                        use_hierarchic_softmax="use_hierarchic_softmax",
-                       cbow="cbow")
+                       cbow="cbow",
+                       use_mesh="mesh")
 
     @staticmethod
     def builder() -> "Word2Vec.Builder":

@@ -29,6 +29,14 @@ State = Dict[str, Array]
 _EPS_DEFAULT = 1e-8
 
 
+def _f32_state(param, names):
+    """Optimizer state is kept in float32 regardless of the param dtype
+    (mixed-precision master state). In bf16, decay constants like 0.999
+    round to 1.0 — Adam's bias correction would divide by zero — so all
+    updater math below runs in f32 and only the delta is cast back."""
+    return {n: jnp.zeros(param.shape, jnp.float32) for n in names}
+
+
 @dataclass
 class UpdaterConfig:
     """Base updater config. learning_rate < 0 means inherit the net-level lr."""
@@ -67,7 +75,7 @@ class Nesterovs(UpdaterConfig):
     momentum_schedule: Dict[str, float] = field(default_factory=dict)
 
     def init_state(self, param):
-        return {"v": jnp.zeros_like(param)}
+        return _f32_state(param, ("v",))
 
     def _momentum(self, step):
         mu = jnp.asarray(self.momentum, jnp.float32)
@@ -76,12 +84,13 @@ class Nesterovs(UpdaterConfig):
         return mu
 
     def apply(self, state, grad, lr, step):
-        mu = self._momentum(step).astype(grad.dtype)
+        g = grad.astype(jnp.float32)
+        mu = self._momentum(step)
         v = state["v"]
-        v_new = mu * v - lr * grad
+        v_new = mu * v - lr.astype(jnp.float32) * g
         # Nesterov look-ahead: params += -mu*v + (1+mu)*v_new
         delta = (1.0 + mu) * v_new - mu * v
-        return delta, {"v": v_new}
+        return delta.astype(grad.dtype), {"v": v_new}
 
 
 @register
@@ -93,18 +102,19 @@ class Adam(UpdaterConfig):
     epsilon: float = _EPS_DEFAULT
 
     def init_state(self, param):
-        return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
+        return _f32_state(param, ("m", "u"))
 
     def apply(self, state, grad, lr, step):
-        t = jnp.asarray(step + 1, grad.dtype)
-        b1 = jnp.asarray(self.beta1, grad.dtype)
-        b2 = jnp.asarray(self.beta2, grad.dtype)
-        m = b1 * state["m"] + (1.0 - b1) * grad
-        u = b2 * state["u"] + (1.0 - b2) * grad * grad
+        g = grad.astype(jnp.float32)
+        t = jnp.asarray(step + 1, jnp.float32)
+        b1 = jnp.float32(self.beta1)
+        b2 = jnp.float32(self.beta2)
+        m = b1 * state["m"] + (1.0 - b1) * g
+        u = b2 * state["u"] + (1.0 - b2) * g * g
         mhat = m / (1.0 - jnp.power(b1, t))
         uhat = u / (1.0 - jnp.power(b2, t))
-        delta = -lr * mhat / (jnp.sqrt(uhat) + self.epsilon)
-        return delta, {"m": m, "u": u}
+        delta = -lr.astype(jnp.float32) * mhat / (jnp.sqrt(uhat) + self.epsilon)
+        return delta.astype(grad.dtype), {"m": m, "u": u}
 
 
 @register
@@ -114,12 +124,13 @@ class AdaGrad(UpdaterConfig):
     epsilon: float = _EPS_DEFAULT
 
     def init_state(self, param):
-        return {"h": jnp.zeros_like(param)}
+        return _f32_state(param, ("h",))
 
     def apply(self, state, grad, lr, step):
-        h = state["h"] + grad * grad
-        delta = -lr * grad / (jnp.sqrt(h) + self.epsilon)
-        return delta, {"h": h}
+        g = grad.astype(jnp.float32)
+        h = state["h"] + g * g
+        delta = -lr.astype(jnp.float32) * g / (jnp.sqrt(h) + self.epsilon)
+        return delta.astype(grad.dtype), {"h": h}
 
 
 @register
@@ -129,14 +140,15 @@ class AdaDelta(UpdaterConfig):
     epsilon: float = 1e-6
 
     def init_state(self, param):
-        return {"eg": jnp.zeros_like(param), "edx": jnp.zeros_like(param)}
+        return _f32_state(param, ("eg", "edx"))
 
     def apply(self, state, grad, lr, step):
-        rho = jnp.asarray(self.rho, grad.dtype)
-        eg = rho * state["eg"] + (1.0 - rho) * grad * grad
-        dx = -jnp.sqrt(state["edx"] + self.epsilon) / jnp.sqrt(eg + self.epsilon) * grad
+        g = grad.astype(jnp.float32)
+        rho = jnp.float32(self.rho)
+        eg = rho * state["eg"] + (1.0 - rho) * g * g
+        dx = -jnp.sqrt(state["edx"] + self.epsilon) / jnp.sqrt(eg + self.epsilon) * g
         edx = rho * state["edx"] + (1.0 - rho) * dx * dx
-        return dx, {"eg": eg, "edx": edx}
+        return dx.astype(grad.dtype), {"eg": eg, "edx": edx}
 
 
 @register
@@ -147,13 +159,14 @@ class RmsProp(UpdaterConfig):
     epsilon: float = _EPS_DEFAULT
 
     def init_state(self, param):
-        return {"eg": jnp.zeros_like(param)}
+        return _f32_state(param, ("eg",))
 
     def apply(self, state, grad, lr, step):
-        d = jnp.asarray(self.rms_decay, grad.dtype)
-        eg = d * state["eg"] + (1.0 - d) * grad * grad
-        delta = -lr * grad / jnp.sqrt(eg + self.epsilon)
-        return delta, {"eg": eg}
+        g = grad.astype(jnp.float32)
+        d = jnp.float32(self.rms_decay)
+        eg = d * state["eg"] + (1.0 - d) * g * g
+        delta = -lr.astype(jnp.float32) * g / jnp.sqrt(eg + self.epsilon)
+        return delta.astype(grad.dtype), {"eg": eg}
 
 
 @register
@@ -165,15 +178,16 @@ class AdaMax(UpdaterConfig):
     epsilon: float = _EPS_DEFAULT
 
     def init_state(self, param):
-        return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
+        return _f32_state(param, ("m", "u"))
 
     def apply(self, state, grad, lr, step):
-        t = jnp.asarray(step + 1, grad.dtype)
-        b1 = jnp.asarray(self.beta1, grad.dtype)
-        m = b1 * state["m"] + (1.0 - b1) * grad
-        u = jnp.maximum(self.beta2 * state["u"], jnp.abs(grad))
-        delta = -lr / (1.0 - jnp.power(b1, t)) * m / (u + self.epsilon)
-        return delta, {"m": m, "u": u}
+        g = grad.astype(jnp.float32)
+        t = jnp.asarray(step + 1, jnp.float32)
+        b1 = jnp.float32(self.beta1)
+        m = b1 * state["m"] + (1.0 - b1) * g
+        u = jnp.maximum(self.beta2 * state["u"], jnp.abs(g))
+        delta = -lr.astype(jnp.float32) / (1.0 - jnp.power(b1, t)) * m / (u + self.epsilon)
+        return delta.astype(grad.dtype), {"m": m, "u": u}
 
 
 UPDATERS = {
