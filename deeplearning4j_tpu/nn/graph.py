@@ -315,6 +315,10 @@ class ComputationGraph:
                               for m in fmasks])) if fmasks else None)
         lmasks_l = ([jnp.asarray(m) if m is not None else None for m in lmasks]
                     if lmasks else None)
+        algo = (self.conf.conf.optimization_algo or
+                "stochastic_gradient_descent").lower()
+        if algo not in ("stochastic_gradient_descent", "sgd"):
+            return self._fit_one_solver(algo, inputs, labels, fmasks_d, lmasks_l)
         step_fn = self._get_train_step((len(inputs), len(labels),
                                         fmasks is not None, lmasks is not None))
         for _ in range(max(1, self.conf.conf.iterations)):
@@ -327,6 +331,37 @@ class ComputationGraph:
             self.step += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.step)
+
+    def _fit_one_solver(self, algo, inputs, labels, fmasks_d, lmasks_l):
+        """Whole-graph training under CG / LBFGS / line-search — reference
+        BaseOptimizer.java:51 driving ComputationGraph.computeGradientAndScore."""
+        from jax.flatten_util import ravel_pytree
+        from ..optimize.solver import OPTIMIZERS
+        cls = OPTIMIZERS.get(algo)
+        if cls is None:
+            raise ValueError(f"Unknown optimization_algo {algo!r}; "
+                             f"available: {sorted(OPTIMIZERS)}")
+        flat0, unravel = ravel_pytree(self.params)
+        self._key, rng = jax.random.split(self._key)
+
+        def objective(flat):
+            params = unravel(flat)
+            acts, _, _ = self._forward_impl(params, self.variables, inputs,
+                                            train=True, rng=rng, fmasks=fmasks_d)
+            loss = self._loss(acts, labels, lmasks_l) + self._reg_loss(params)
+            return loss.astype(jnp.float32)
+
+        lrs = [v.layer.learning_rate for v in self.conf.vertices.values()
+               if getattr(v, "layer", None) is not None]
+        lr = lrs[0] if lrs else 0.1
+        opt = cls(objective, max_iterations=max(1, self.conf.conf.iterations),
+                  learning_rate=lr)
+        flat = opt.optimize(flat0)
+        self.params = unravel(jnp.asarray(flat, flat0.dtype))
+        self.score_ = opt.score_
+        self.step += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.step)
 
     # -- inference -------------------------------------------------------------
     def _get_forward(self, n_inputs: int):

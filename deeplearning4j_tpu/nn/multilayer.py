@@ -207,6 +207,14 @@ class MultiLayerNetwork:
         y = jnp.asarray(y)
         fmask = jnp.asarray(fmask) if fmask is not None else None
         lmask = jnp.asarray(lmask) if lmask is not None else None
+        algo = (self.conf.conf.optimization_algo or
+                "stochastic_gradient_descent").lower()
+        if algo not in ("stochastic_gradient_descent", "sgd"):
+            if carry_state:
+                raise NotImplementedError(
+                    f"optimization_algo={algo!r} is not supported with "
+                    "truncated BPTT; use stochastic_gradient_descent")
+            return self._fit_batch_solver(algo, x, y, fmask, lmask)
         step_fn = self._get_train_step((fmask is not None, lmask is not None, carry_state))
         out_states = states
         for _ in range(max(1, self.conf.conf.iterations)):
@@ -220,6 +228,46 @@ class MultiLayerNetwork:
             for listener in self.listeners:
                 listener.iteration_done(self, self.step)
         return out_states
+
+    def _fit_batch_solver(self, algo: str, x, y, fmask, lmask):
+        """Whole-net training under a classic optimizer (CG / LBFGS /
+        line-search gradient descent) — the reference drives
+        computeGradientAndScore through these when conf.optimizationAlgo
+        selects them (optimize/solvers/BaseOptimizer.java:51,
+        ConjugateGradient.java, LBFGS.java). The objective is the minibatch
+        loss (+ regularization) over the flat parameter vector; conf.iterations
+        bounds the optimizer iterations per minibatch, matching the
+        reference's `iterations` semantics."""
+        from jax.flatten_util import ravel_pytree
+        from ..optimize.solver import OPTIMIZERS
+        cls = OPTIMIZERS.get(algo)
+        if cls is None:
+            raise ValueError(
+                f"Unknown optimization_algo {algo!r}; available: "
+                f"{sorted(OPTIMIZERS)}")
+        flat0, unravel = ravel_pytree(self.params)
+        self._key, rng = jax.random.split(self._key)
+
+        def objective(flat):
+            params = unravel(flat)
+            acts, _, _ = self._forward_impl(params, self.variables, x,
+                                            train=True, rng=rng, fmask=fmask)
+            loss = self._loss_from_output(acts[-1], y, lmask)
+            return (loss + self._reg_loss(params)).astype(jnp.float32)
+
+        lr = self.conf.layers[0].learning_rate if self.conf.layers else 0.1
+        opt = cls(objective, max_iterations=max(1, self.conf.conf.iterations),
+                  learning_rate=lr)
+        flat = opt.optimize(flat0)
+        self.params = unravel(jnp.asarray(flat, flat0.dtype))
+        # refresh batch-dependent variables (e.g. BN running stats) once
+        _, self.variables, _ = self._forward_impl(self.params, self.variables, x,
+                                                  train=True, rng=rng, fmask=fmask)
+        self.score_ = opt.score_
+        self.step += 1
+        for listener in self.listeners:
+            listener.iteration_done(self, self.step)
+        return None
 
     # ------------------------------------------------------------------ fit --
     def fit(self, data, labels=None):

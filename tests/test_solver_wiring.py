@@ -1,0 +1,90 @@
+"""optimization_algo wired into whole-net training.
+
+Parity with the reference's BaseOptimizer.java:51 family: conf.optimizationAlgo
+can select CONJUGATE_GRADIENT / LBFGS / LINE_GRADIENT_DESCENT and the optimizer
+then drives computeGradientAndScore over the whole net (VERDICT round-1 item 8:
+previously the setting was silently ignored).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration, Sgd)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, GravesLSTM,
+                                               OutputLayer, RnnOutputLayer)
+
+
+def _iris_net(algo, iterations):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .learning_rate(0.1)
+            .updater(Sgd())
+            .optimization_algo(algo)
+            .iterations(iterations)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.mark.parametrize("algo", ["conjugate_gradient", "lbfgs",
+                                  "line_gradient_descent"])
+def test_mlp_iris_trains_under_classic_optimizers(algo):
+    ds = load_iris_dataset()
+    net = _iris_net(algo, iterations=25)
+    initial = net.score(x=ds.features, y=ds.labels)
+    net.fit(ds.features, ds.labels)
+    final = net.score(x=ds.features, y=ds.labels)
+    assert np.isfinite(final)
+    assert final < initial * 0.7, f"{algo}: score {initial} -> {final}"
+
+
+def test_unknown_algo_raises():
+    ds = load_iris_dataset()
+    net = _iris_net("quantum_annealing", iterations=1)
+    with pytest.raises(ValueError, match="optimization_algo"):
+        net.fit(ds.features, ds.labels)
+
+
+def test_tbptt_with_classic_optimizer_raises():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05)
+            .optimization_algo("lbfgs")
+            .list()
+            .layer(GravesLSTM(n_in=3, n_out=8))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss="negativeloglikelihood"))
+            .backprop_type("truncated_bptt")
+            .t_bptt_forward_length(5).t_bptt_backward_length(5)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 10, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.default_rng(1).integers(0, 3, (4, 10))]
+    with pytest.raises(NotImplementedError):
+        net.fit(x, y)
+
+
+def test_graph_trains_under_lbfgs():
+    ds = load_iris_dataset()
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1)
+            .optimization_algo("lbfgs")
+            .iterations(25)
+            .weight_init("xavier")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=16, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                          loss="negativeloglikelihood"), "d")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    initial = net.score(inputs=[ds.features], labels=[ds.labels])
+    net.fit(ds.features, ds.labels)
+    final = net.score(inputs=[ds.features], labels=[ds.labels])
+    assert np.isfinite(final)
+    assert final < initial * 0.7, f"lbfgs graph: {initial} -> {final}"
