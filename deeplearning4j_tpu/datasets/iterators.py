@@ -163,10 +163,11 @@ class AsyncDataSetIterator(DataSetIterator):
     def _start(self):
         self._queue = queue.Queue(self._size)
         self._error = None
+        self._stop = False
 
         def worker():
             try:
-                while True:
+                while not self._stop:
                     ds = self._under.next_batch()
                     self._queue.put(self._SENTINEL if ds is None else ds)
                     if ds is None:
@@ -183,7 +184,10 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def reset(self) -> None:
         if self._thread is not None and self._thread.is_alive():
-            # drain so the worker can exit
+            # signal stop, then unblock a possibly-full queue; the worker
+            # exits at its next loop check instead of walking the whole
+            # underlying iterator to exhaustion
+            self._stop = True
             while self._thread.is_alive():
                 try:
                     self._queue.get(timeout=0.01)

@@ -50,7 +50,10 @@ class MultiLayerNetwork:
         self.variables: List[Dict[str, Array]] = []
         self.updater_state: List[Dict[str, Dict[str, Array]]] = []
         self.step = 0
-        self.score_ = float("nan")
+        self._score_raw: Any = float("nan")
+        # minibatches fused per device dispatch in fit(iterator) — one jitted
+        # lax.scan over a [K, B, ...] stack (kills the per-step host floor)
+        self.scan_batches = 16
         self.listeners: List[Any] = []
         self._rnn_state: Dict[int, Dict[str, Array]] = {}
         self._jit_cache: Dict[Any, Any] = {}
@@ -78,6 +81,36 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
 
+    # score_ is lazily materialized: the training paths store the device
+    # scalar and only block on device->host transfer when someone reads it
+    # (listener/early-stopping), keeping the dispatch pipeline full.
+    @property
+    def score_(self) -> float:
+        v = self._score_raw
+        if not isinstance(v, float):
+            v = float(v)
+            self._score_raw = v
+        return v
+
+    @score_.setter
+    def score_(self, v):
+        self._score_raw = v
+
+    def _adapt_input(self, x: Array) -> Array:
+        """Adapt raw data to the declared input type — the reference inserts
+        this automatically (nn/conf/layers/setup/ConvolutionLayerSetup.java:37):
+        flat [B, h*w*c] rows fed to a net declared convolutional are reshaped
+        to NHWC; [B,h,w] grayscale gets its channel axis."""
+        it = self.conf.input_type
+        if it is None or getattr(it, "kind", None) != "convolutional":
+            return x
+        h, w, c = it.hwc()
+        if x.ndim == 2 and x.shape[1] == h * w * c:
+            return x.reshape(x.shape[0], h, w, c)
+        if x.ndim == 3 and c == 1 and x.shape[1:] == (h, w):
+            return x[..., None]
+        return x
+
     # ------------------------------------------------------------- forward ---
     def _forward_impl(self, params, variables, x, *, train, rng, fmask=None,
                       states=None, upto: Optional[int] = None):
@@ -85,6 +118,7 @@ class MultiLayerNetwork:
         (activations per layer, new variables, new rnn states)."""
         conf = self.conf
         n = len(self._impls) if upto is None else upto
+        x = self._adapt_input(x)
         timesteps = x.shape[1] if x.ndim == 3 else 1
         if rng is None:
             rngs = [None] * n
@@ -199,6 +233,93 @@ class MultiLayerNetwork:
         self._jit_cache[key] = fn
         return fn
 
+    # ------------------------------------------------- multi-step (scan) -----
+    def _build_multi_step(self, key):
+        """K optimization steps as ONE device program: lax.scan over a
+        [K, B, ...] stack of minibatches. Replaces K host dispatches (and K
+        blocking loss fetches) with a single dispatch + one [K] loss fetch —
+        the TPU answer to the reference's per-minibatch Solver.optimize()
+        round trip (MultiLayerNetwork.java:1033-1062)."""
+        has_fmask, has_lmask = key
+        base = self._build_train_step((has_fmask, has_lmask, False))
+
+        def multi_step(params, variables, ustates, step0, rng, xs, ys, fms, lms):
+            def body(carry, inp):
+                params, variables, ustates, step = carry
+                x, y, fm, lm = inp
+                sub = jax.random.fold_in(rng, step)
+                p, v, u, loss, _ = base(params, variables, ustates, step, sub,
+                                        x, y, fm if has_fmask else None,
+                                        lm if has_lmask else None, None)
+                return (p, v, u, step + 1), loss
+
+            k = xs.shape[0]
+            dummy = jnp.zeros((k,), jnp.float32)  # keeps scan xs-tree static
+            (params, variables, ustates, _), losses = jax.lax.scan(
+                body, (params, variables, ustates, step0),
+                (xs, ys, fms if has_fmask else dummy,
+                 lms if has_lmask else dummy))
+            return params, variables, ustates, losses
+
+        return multi_step
+
+    def fit_scan(self, xs, ys, fms=None, lms=None):
+        """Run xs.shape[0] training steps fully device-resident.
+
+        xs: [K, B, ...] stacked minibatches, ys: [K, B, ...] labels. Returns
+        the [K] per-step losses (device array; not fetched unless listeners
+        are attached).
+
+        Each xs[k] is ONE optimization step (no TBPTT windowing or RNN state
+        carry across slices — for TBPTT nets each slice must be a single
+        window, which is enforced below). Listeners get the exact per-step
+        score, but observe the model's end-of-chunk parameters: per-step
+        parameter snapshots require the one-step-per-dispatch `fit_batch`."""
+        self._check_init()
+        if not self._can_scan():
+            raise ValueError(
+                "fit_scan requires SGD-class training (optimization_algo="
+                "stochastic_gradient_descent, iterations=1, scan_batches>1); "
+                "use fit()/fit_batch for solver-driven or multi-iteration "
+                "configurations")
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        if (self.conf.backprop_type == BACKPROP_TBPTT and xs.ndim == 4
+                and xs.shape[2] > self.conf.tbptt_fwd_length):
+            raise ValueError(
+                f"fit_scan slices have T={xs.shape[2]} > tbptt_fwd_length="
+                f"{self.conf.tbptt_fwd_length}; fit_scan does not window — "
+                "pass single TBPTT windows or use fit()")
+        key = (fms is not None, lms is not None)
+        cache_key = ("multi", key)
+        if cache_key not in self._jit_cache:
+            self._jit_cache[cache_key] = jax.jit(
+                self._build_multi_step(key), donate_argnums=(0, 1, 2))
+        fn = self._jit_cache[cache_key]
+        self._key, sub = jax.random.split(self._key)
+        k = int(xs.shape[0])
+        (self.params, self.variables, self.updater_state, losses) = fn(
+            self.params, self.variables, self.updater_state,
+            jnp.asarray(self.step), sub, xs, ys,
+            jnp.asarray(fms) if fms is not None else None,
+            jnp.asarray(lms) if lms is not None else None)
+        self.step += k
+        self._score_raw = losses[-1]
+        if self.listeners:
+            host_losses = np.asarray(losses)
+            for j in range(k):
+                self._score_raw = float(host_losses[j])
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.step - k + 1 + j)
+        return losses
+
+    def _can_scan(self) -> bool:
+        algo = (self.conf.conf.optimization_algo or
+                "stochastic_gradient_descent").lower()
+        return (self.scan_batches > 1
+                and self.conf.conf.iterations <= 1
+                and algo in ("stochastic_gradient_descent", "sgd"))
+
     def fit_batch(self, x, y, fmask=None, lmask=None, states=None,
                   carry_state=False):
         """One (or conf.iterations) optimization step(s) on a single minibatch."""
@@ -223,7 +344,7 @@ class MultiLayerNetwork:
              out_states) = step_fn(self.params, self.variables, self.updater_state,
                                    jnp.asarray(self.step), sub, x, y, fmask, lmask,
                                    states if carry_state else None)
-            self.score_ = float(loss)
+            self._score_raw = loss  # lazy: no blocking device->host fetch
             self.step += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.step)
@@ -288,11 +409,76 @@ class MultiLayerNetwork:
             if hasattr(data, "reset"):
                 data.reset()
         if self.conf.backprop:
-            for ds in data:
+            self._fit_iterator(data)
+        return self
+
+    def _fit_iterator(self, iterator):
+        """Drive fit over a DataSetIterator: background prefetch (reference
+        wraps in AsyncDataSetIterator, MultiLayerNetwork.java:1016-1018) +
+        fusing runs of same-shape unmasked minibatches into one device-resident
+        lax.scan dispatch (`fit_scan`)."""
+        from ..datasets.iterators import AsyncDataSetIterator, DataSetIterator
+        wrapped = (isinstance(iterator, DataSetIterator)
+                   and not isinstance(iterator, AsyncDataSetIterator))
+        if wrapped:
+            # reset the UNDERLYING iterator first (matching `for ds in it`
+            # semantics), then consume the async wrapper without reset — an
+            # AsyncDataSetIterator.reset right after construction would
+            # discard the batches the worker already prefetched
+            iterator.reset()
+            it = AsyncDataSetIterator(iterator,
+                                      queue_size=2 * self.scan_batches)
+
+            def batches():
+                while True:
+                    ds = it.next_batch()
+                    if ds is None:
+                        return
+                    yield ds
+
+            source = batches()
+        else:
+            source = iter(iterator)
+        use_scan = self._can_scan() and self.conf.backprop_type != BACKPROP_TBPTT
+        if not use_scan:
+            for ds in source:
                 self._fit_one(ds.features, ds.labels,
                               getattr(ds, "features_mask", None),
                               getattr(ds, "labels_mask", None))
-        return self
+            return
+
+        buf: List[Any] = []
+
+        def flush():
+            if not buf:
+                return
+            if len(buf) < self.scan_batches:
+                # partial chunk: reuse the single-step program instead of
+                # compiling a one-off scan for this K
+                for d in buf:
+                    self.fit_batch(d.features, d.labels)
+            else:
+                xs = np.stack([np.asarray(d.features) for d in buf])
+                ys = np.stack([np.asarray(d.labels) for d in buf])
+                self.fit_scan(xs, ys)
+            buf.clear()
+
+        buf_shapes = None
+        for ds in source:
+            fm = getattr(ds, "features_mask", None)
+            lm = getattr(ds, "labels_mask", None)
+            if fm is not None or lm is not None:
+                flush()
+                self._fit_one(ds.features, ds.labels, fm, lm)
+                continue
+            shapes = (ds.features.shape, ds.labels.shape)
+            if buf and shapes != buf_shapes:
+                flush()
+            buf_shapes = shapes
+            buf.append(ds)
+            if len(buf) >= self.scan_batches:
+                flush()
+        flush()
 
     def _fit_one(self, x, y, fmask, lmask):
         if (self.conf.backprop_type == BACKPROP_TBPTT
