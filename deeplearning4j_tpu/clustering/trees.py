@@ -44,15 +44,15 @@ class VPTree:
         vp_pos = int(self._rng.integers(0, len(idx)))
         idx[0], idx[vp_pos] = idx[vp_pos], idx[0]
         vp = idx[0]
-        rest = idx[1:]
-        dists = [self._dist(vp, i) for i in rest]
-        median = float(np.median(dists)) if dists else 0.0
+        rest = np.asarray(idx[1:], np.int64)
+        # one vectorized distance sweep per node (not a per-pair Python
+        # loop) — keeps 100k-point builds in the seconds range
+        dists = np.linalg.norm(self.items[rest] - self.items[vp], axis=1)
+        median = float(np.median(dists)) if dists.size else 0.0
         node = VPTree._Node(vp)
         node.threshold = median
-        inner = [i for i, d in zip(rest, dists) if d < median]
-        outer = [i for i, d in zip(rest, dists) if d >= median]
-        node.left = self._build(inner)
-        node.right = self._build(outer)
+        node.left = self._build(list(rest[dists < median]))
+        node.right = self._build(list(rest[dists >= median]))
         return node
 
     def search(self, target, k: int = 1) -> Tuple[List[int], List[float]]:

@@ -48,12 +48,26 @@ class ComputationGraph:
         self.variables: Dict[str, Dict[str, Array]] = {}
         self.updater_state: Dict[str, Dict[str, Dict[str, Array]]] = {}
         self.step = 0
-        self.score_ = float("nan")
+        self._score_raw: Any = float("nan")
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Any] = {}
         self._jit_cache: Dict[Any, Any] = {}
         self._key = jax.random.PRNGKey(conf.conf.seed)
         self._initialized = False
+
+    # score_ materializes lazily so training never blocks on a device->host
+    # loss fetch (same contract as MultiLayerNetwork.score_)
+    @property
+    def score_(self) -> float:
+        v = self._score_raw
+        if not isinstance(v, float):
+            v = float(v)
+            self._score_raw = v
+        return v
+
+    @score_.setter
+    def score_(self, v):
+        self._score_raw = v
 
     # -- init ------------------------------------------------------------------
     def init(self) -> "ComputationGraph":
@@ -327,7 +341,7 @@ class ComputationGraph:
              loss) = step_fn(self.params, self.variables, self.updater_state,
                              jnp.asarray(self.step), sub, inputs, labels,
                              fmasks_d, lmasks_l)
-            self.score_ = float(loss)
+            self._score_raw = loss  # lazy: no blocking device->host fetch
             self.step += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.step)

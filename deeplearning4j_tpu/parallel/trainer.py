@@ -139,9 +139,14 @@ class IciDataParallelTrainingMaster(TrainingMaster):
     (ParameterAveragingTrainingMaster.java:358-380) collapses into it.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, collect_stats: bool = False):
+    def __init__(self, mesh: Optional[Mesh] = None, collect_stats: bool = False,
+                 state_tracker=None):
         self.mesh = mesh or default_mesh()
         self.stats = SparkTrainingStats() if collect_stats else None
+        # fault tolerance: periodic atomic checkpoints (statetracker.py)
+        self.state_tracker = state_tracker
+        self._batches_done = 0
+        self._skip = 0
 
     def _get_step(self, net, has_fm: bool, has_lm: bool):
         key = ("ici_step", has_fm, has_lm)
@@ -149,6 +154,18 @@ class IciDataParallelTrainingMaster(TrainingMaster):
             net._jit_cache[key] = jax.jit(_unified_step(net, has_fm, has_lm),
                                           donate_argnums=(0, 2))
         return net._jit_cache[key]
+
+    def resume(self, net) -> int:
+        """Restore the newest checkpoint into `net`; returns how many
+        leading batches of the SAME data sequence execute_training should
+        skip (the redelivery semantics of StateTracker.java:122-129)."""
+        if self.state_tracker is None:
+            return 0
+        cursor = self.state_tracker.restore(net) or {}
+        skip = int(cursor.get("master_batches", 0))
+        self._batches_done = skip
+        self._skip = skip
+        return skip
 
     def execute_training(self, net, iterator) -> None:
         net._check_init()
@@ -158,7 +175,15 @@ class IciDataParallelTrainingMaster(TrainingMaster):
         net.variables = _tree_put(net.variables, repl)
         net.updater_state = _tree_put(net.updater_state, repl)
         n_dev = self.mesh.size
+        # resumed run: skip the batches already trained before the restored
+        # checkpoint (call resume(net) first; the iterator must replay the
+        # same sequence)
+        skip = self._skip
+        self._skip = 0
         for ds in iterator:
+            if skip > 0:
+                skip -= 1
+                continue
             with phase_timer(self.stats, "data_fetch"):
                 inputs, labels, fms, lms = _as_lists(ds)
                 inputs = [np.asarray(a) for a in inputs]
@@ -183,10 +208,14 @@ class IciDataParallelTrainingMaster(TrainingMaster):
                 (net.params, net.variables, net.updater_state,
                  loss) = step_fn(net.params, net.variables, net.updater_state,
                                  jnp.asarray(net.step), sub, xs, ys, fmss, lmss)
-                net.score_ = float(loss)
+                net.score_ = loss  # lazily fetched (see MultiLayerNetwork.score_)
                 net.step += 1
             for listener in net.listeners:
                 listener.iteration_done(net, net.step)
+            self._batches_done += 1
+            if self.state_tracker is not None:
+                self.state_tracker.batch_done(
+                    net, {"master_batches": self._batches_done})
 
     def get_training_stats(self):
         return self.stats
@@ -204,11 +233,20 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     """
 
     def __init__(self, batch_size_per_worker: int = 16, averaging_frequency: int = 1,
-                 mesh: Optional[Mesh] = None, collect_stats: bool = False):
+                 mesh: Optional[Mesh] = None, collect_stats: bool = False,
+                 state_tracker=None):
         self.batch_size_per_worker = batch_size_per_worker
         self.averaging_frequency = max(1, averaging_frequency)
         self.mesh = mesh or default_mesh()
         self.stats = SparkTrainingStats() if collect_stats else None
+        # fault tolerance: checkpoint at averaging-round boundaries — the
+        # consistent cut where params/updater state are globally agreed.
+        # NOTE: these checkpoints restore MODEL state (params/updater/step);
+        # data-cursor replay for this master is driver-level — use
+        # statetracker.fit_with_recovery, which owns the cursor (and
+        # disables this master-side hook while driving)
+        self.state_tracker = state_tracker
+        self._rounds_done = 0
 
     # -- the shard_map'd worker round ------------------------------------------
     def _get_round_fn(self, net, has_fm: bool):
@@ -376,10 +414,14 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                                       net.updater_state,
                                       jnp.asarray(net.step), sub,
                                       xs, ys, fs, ls)
-                net.score_ = float(loss)
+                net.score_ = loss  # lazily fetched
                 net.step += n
             for listener in net.listeners:
                 listener.iteration_done(net, net.step)
+            self._rounds_done += 1
+            if self.state_tracker is not None:
+                self.state_tracker.batch_done(net,
+                                              {"round": self._rounds_done})
 
         with phase_timer(self.stats, "total_training"):
             for ds in iterator:

@@ -1,0 +1,7 @@
+from .server import InferenceServer
+from .streaming import (QueueDataSetIterator, RecordToDataSetConverter,
+                        ServeRoute, StreamingTrainingPipeline)
+
+__all__ = ["InferenceServer", "QueueDataSetIterator",
+           "RecordToDataSetConverter", "ServeRoute",
+           "StreamingTrainingPipeline"]

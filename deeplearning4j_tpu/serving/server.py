@@ -1,0 +1,118 @@
+"""HTTP model-serving endpoint.
+
+Capability parity with the reference's serving route
+(dl4j-streaming/.../routes/DL4jServeRouteBuilder.java: load a serialized
+model, vectorize incoming records, emit predictions) — exposed over HTTP
+(stdlib ThreadingHTTPServer, same stack as ui/server.py) instead of a
+Camel/Kafka route; see streaming.py for the queue-fed variant.
+
+Endpoints:
+  GET  /health            {"status": "ok", "model": "...", "params": N}
+  GET  /info              model summary + config JSON
+  POST /predict           {"data": [[...], ...]}  -> probabilities + argmax
+  POST /predict/csv       text/plain CSV rows     -> same, via the
+                          RecordToDataSetConverter (label column ignored)
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional, Union
+
+import numpy as np
+
+from .streaming import RecordToDataSetConverter
+
+
+class InferenceServer:
+    def __init__(self, net=None, model_path: Union[str, Path, None] = None,
+                 port: int = 0, max_batch: int = 1024,
+                 converter: Optional[RecordToDataSetConverter] = None):
+        if net is None:
+            if model_path is None:
+                raise ValueError("pass a net or a model_path")
+            from ..util.model_serializer import restore_multi_layer_network
+            net = restore_multi_layer_network(model_path)
+        self.net = net
+        self.max_batch = max_batch
+        self.converter = converter or RecordToDataSetConverter(label_index=None)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._port = port
+        self._lock = threading.Lock()  # output() mutates net._jit_cache etc.
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def _predict(self, arr: np.ndarray) -> dict:
+        outs = []
+        with self._lock:
+            for off in range(0, arr.shape[0], self.max_batch):
+                outs.append(np.asarray(
+                    self.net.output(arr[off:off + self.max_batch])))
+        out = np.concatenate(outs) if outs else np.zeros((0, 0), np.float32)
+        return {
+            "predictions": out.astype(float).tolist(),
+            "classes": np.argmax(out, axis=-1).astype(int).tolist()
+            if out.ndim >= 2 and out.shape[-1] > 0 else [],
+        }
+
+    def start(self) -> "InferenceServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/health"):
+                    self._send({"status": "ok",
+                                "model": type(server.net).__name__,
+                                "params": server.net.num_params()})
+                elif self.path.startswith("/info"):
+                    self._send({"model": type(server.net).__name__,
+                                "config": json.loads(server.net.conf.to_json()),
+                                "params": server.net.num_params()})
+                else:
+                    self._send({"error": "not found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                try:
+                    if self.path.startswith("/predict/csv"):
+                        rows = [line.split(",") for line in
+                                raw.decode().strip().splitlines() if line.strip()]
+                        ds = server.converter.convert(rows)
+                        self._send(server._predict(np.asarray(ds.features)))
+                    elif self.path.startswith("/predict"):
+                        payload = json.loads(raw.decode())
+                        arr = np.asarray(payload["data"], np.float32)
+                        self._send(server._predict(arr))
+                    else:
+                        self._send({"error": "not found"}, 404)
+                except Exception as e:  # bad payloads must not kill the server
+                    self._send({"error": str(e)}, 400)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,213 @@
+"""Streaming pipelines: queue-fed inference routes + train-from-stream.
+
+Capability parity with `dl4j-streaming` (SURVEY.md §2.4):
+  - `DL4jServeRouteBuilder.java` — Camel/Kafka route: record in -> vectorize
+    -> model.output -> prediction out. Here the transport is a thread-safe
+    queue (the Kafka/Camel broker seam is environment infrastructure; the
+    route semantics — converter, batched inference, result emission — are
+    what carries over).
+  - `SparkStreamingPipeline.java` (train) — a DataSetIterator fed from a
+    live stream so any TrainingMaster / net.fit can consume it.
+  - `streaming/conversion/` record<->NDArray converters — here
+    RecordToDataSetConverter reuses the record-reader value conventions
+    (datasets/records.py: label column index, one-hot classes).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..datasets.dataset import DataSet
+from ..datasets.iterators import DataSetIterator
+
+
+class RecordToDataSetConverter:
+    """Vectorize CSV-style records (lists of str/float) into a DataSet —
+    the record<->array conversion seam (reference
+    dl4j-streaming/.../conversion/, datasets/canova/RecordReaderDataSetIterator
+    label handling)."""
+
+    def __init__(self, label_index: Optional[int] = None,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False):
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+
+    def convert(self, records: Sequence[Sequence]) -> DataSet:
+        rows = [[float(v) for v in r] for r in records]
+        arr = np.asarray(rows, np.float32)
+        if self.label_index is None:
+            return DataSet(arr, np.zeros((arr.shape[0], 0), np.float32))
+        li = self.label_index if self.label_index >= 0 else arr.shape[1] - 1
+        labels = arr[:, li]
+        feats = np.delete(arr, li, axis=1)
+        if self.regression:
+            y = labels[:, None]
+        else:
+            n = self.num_classes or int(labels.max()) + 1
+            y = np.eye(n, dtype=np.float32)[labels.astype(np.int64)]
+        return DataSet(feats, y)
+
+
+class QueueDataSetIterator(DataSetIterator):
+    """DataSetIterator fed from a live stream (train-from-stream;
+    reference SparkStreamingPipeline). Producers push DataSets (or records
+    through `push_records`); the training loop consumes until `end()` or a
+    poll timeout."""
+
+    def __init__(self, converter: Optional[RecordToDataSetConverter] = None,
+                 batch_size: int = 32, poll_timeout: float = 0.5,
+                 idle_timeout: Optional[float] = None, maxsize: int = 1024):
+        self._queue: "queue.Queue" = queue.Queue(maxsize)
+        self._converter = converter
+        self._batch = batch_size
+        self._timeout = poll_timeout
+        # None = wait for data indefinitely until end() — a producer gap must
+        # NOT be mistaken for end-of-stream (silent training truncation);
+        # set a number only when the consumer should give up after idling
+        self._idle_timeout = idle_timeout
+        self._closed = False
+
+    def push(self, ds: DataSet) -> None:
+        self._queue.put(ds)
+
+    def push_records(self, records: Sequence[Sequence]) -> None:
+        if self._converter is None:
+            raise ValueError("push_records requires a converter")
+        self._queue.put(self._converter.convert(records))
+
+    def end(self) -> None:
+        """Signal end-of-stream: consumers drain and stop."""
+        self._closed = True
+        self._queue.put(None)
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def reset(self) -> None:  # a stream has no beginning to return to
+        pass
+
+    def next_batch(self) -> Optional[DataSet]:
+        """Blocks for data; returns None ONLY at end-of-stream (end() was
+        called and the queue is drained) or after `idle_timeout` seconds of
+        no data (when configured)."""
+        import time as _time
+        deadline = (None if self._idle_timeout is None
+                    else _time.monotonic() + self._idle_timeout)
+        while True:
+            try:
+                return self._queue.get(timeout=self._timeout)
+            except queue.Empty:
+                if self._closed:
+                    return None
+                if deadline is not None and _time.monotonic() >= deadline:
+                    return None
+
+
+class StreamingTrainingPipeline:
+    """Train-from-stream driver (reference SparkStreamingPipeline.java):
+    spawns a consumer thread running net.fit (or a TrainingMaster) over a
+    QueueDataSetIterator while producers push records live."""
+
+    def __init__(self, net, converter: Optional[RecordToDataSetConverter] = None,
+                 master=None, batch_size: int = 32):
+        self.net = net
+        self.master = master
+        self.iterator = QueueDataSetIterator(converter, batch_size)
+        self._thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+    def start(self) -> "StreamingTrainingPipeline":
+        def run():
+            try:
+                if self.master is not None:
+                    self.master.execute_training(self.net, self.iterator)
+                else:
+                    while True:
+                        ds = self.iterator.next_batch()
+                        if ds is None:
+                            return
+                        self.net.fit_batch(ds.features, ds.labels)
+            except BaseException as e:
+                self.error = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def push_records(self, records: Sequence[Sequence]) -> None:
+        self.iterator.push_records(records)
+
+    def push(self, ds: DataSet) -> None:
+        self.iterator.push(ds)
+
+    def finish(self, timeout: float = 60.0) -> None:
+        self.iterator.end()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self.error is not None:
+            raise self.error
+
+
+class ServeRoute:
+    """Queue-fed inference route (reference DL4jServeRouteBuilder.java):
+    records in -> converter -> batched model.output -> `on_prediction`
+    callback (the 'final processor' seam). Batches greedily up to
+    `max_batch` to amortize device dispatch."""
+
+    def __init__(self, net, converter: RecordToDataSetConverter,
+                 on_prediction: Callable[[np.ndarray], None],
+                 max_batch: int = 256, poll_timeout: float = 2.0):
+        self.net = net
+        self.converter = converter
+        self.on_prediction = on_prediction
+        self.max_batch = max_batch
+        self._queue: "queue.Queue" = queue.Queue()
+        self._timeout = poll_timeout
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self.error: Optional[BaseException] = None
+
+    def start(self) -> "ServeRoute":
+        def run():
+            try:
+                while not self._stop:
+                    try:
+                        first = self._queue.get(timeout=self._timeout)
+                    except queue.Empty:
+                        continue
+                    if first is None:
+                        return
+                    batch = [first]
+                    while len(batch) < self.max_batch:
+                        try:
+                            nxt = self._queue.get_nowait()
+                        except queue.Empty:
+                            break
+                        if nxt is None:
+                            self._stop = True
+                            break
+                        batch.append(nxt)
+                    ds = self.converter.convert(batch)
+                    out = np.asarray(self.net.output(ds.features))
+                    self.on_prediction(out)
+            except BaseException as e:
+                self.error = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def send(self, record: Sequence) -> None:
+        self._queue.put(list(record))
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self.error is not None:
+            raise self.error

@@ -112,3 +112,24 @@ class ConvolutionalIterationListener(IterationListener):
         _post(f"{self.server_url}/weights/update?sid={self.session_id}_conv",
               {"iteration": iteration, "score": float(model.score_),
                "activations": stats})
+
+
+def post_tsne(server_url: str, coords, labels=None,
+              session_id: str = "default") -> None:
+    """Upload a t-SNE embedding for the /tsne view (reference
+    deeplearning4j-ui tsne resource: coordinates + labels -> scatter)."""
+    _post(f"{server_url.rstrip('/')}/tsne/update?sid={session_id}",
+          {"coords": np.asarray(coords, float).tolist(),
+           "labels": list(labels) if labels is not None else []})
+
+
+def post_word_vectors(server_url: str, word_vectors,
+                      session_id: str = "default") -> None:
+    """Index a fitted embedding model (Word2Vec/SequenceVectors) for the
+    /nearestneighbors view (reference nearestneighbors resource, vptree-
+    backed: UiServer builds the VPTree server-side)."""
+    vocab = word_vectors.vocab
+    labels = [vocab.word_at_index(i) for i in range(vocab.num_words())]
+    vectors = np.asarray(word_vectors.lookup_table.syn0, float).tolist()
+    _post(f"{server_url.rstrip('/')}/nearestneighbors/update?sid={session_id}",
+          {"labels": labels, "vectors": vectors})

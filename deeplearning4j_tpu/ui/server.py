@@ -55,14 +55,65 @@ setInterval(refresh, 2000); refresh();
 </script></body></html>"""
 
 
+_TSNE_PAGE = """<!DOCTYPE html>
+<html><head><title>t-SNE</title></head><body style="font-family:sans-serif">
+<h2>t-SNE embedding</h2>
+<canvas id="c" width="800" height="600" style="border:1px solid #ccc"></canvas>
+<script>
+async function draw() {
+  const d = await (await fetch('/tsne/data' + location.search)).json();
+  if (!d.coords || !d.coords.length) return;
+  const xs = d.coords.map(p=>p[0]), ys = d.coords.map(p=>p[1]);
+  const minx=Math.min(...xs), maxx=Math.max(...xs);
+  const miny=Math.min(...ys), maxy=Math.max(...ys);
+  const c = document.getElementById('c').getContext('2d');
+  c.clearRect(0,0,800,600); c.font = '10px sans-serif';
+  d.coords.forEach((p,i) => {
+    const x = 20 + 760*(p[0]-minx)/Math.max(maxx-minx,1e-9);
+    const y = 20 + 560*(p[1]-miny)/Math.max(maxy-miny,1e-9);
+    c.fillStyle = '#0074D9'; c.fillRect(x-1,y-1,3,3);
+    if (d.labels && d.labels[i]) { c.fillStyle='#333'; c.fillText(d.labels[i], x+3, y); }
+  });
+}
+draw(); setInterval(draw, 5000);
+</script></body></html>"""
+
+_NN_PAGE = """<!DOCTYPE html>
+<html><head><title>Nearest neighbors</title></head>
+<body style="font-family:sans-serif">
+<h2>Nearest neighbors (VPTree)</h2>
+<input id="w" placeholder="word"/> <input id="k" value="10" size="3"/>
+<button onclick="go()">search</button><ul id="out"></ul>
+<script>
+async function go() {
+  const w = document.getElementById('w').value;
+  const k = document.getElementById('k').value;
+  const r = await (await fetch('/nearestneighbors/search?word=' +
+      encodeURIComponent(w) + '&k=' + k + (location.search ?
+      '&' + location.search.slice(1) : ''))).json();
+  document.getElementById('out').innerHTML =
+    (r.neighbors||[]).map(n => '<li>' + n.label + ' (' +
+                          n.distance.toFixed(4) + ')</li>').join('');
+}
+</script></body></html>"""
+
+
 class UiServer:
-    """Reference UiServer (singleton getInstance() pattern)."""
+    """Reference UiServer (singleton getInstance() pattern).
+
+    Round-3 adds the reference's remaining per-view REST resources
+    (deeplearning4j-ui/.../tsne/ and nearestneighbors/): uploaded t-SNE
+    coordinates render as a scatter page, and uploaded word vectors are
+    VPTree-indexed (reference nearestneighbors resource is vptree-backed)
+    for interactive nearest-label search."""
 
     _instance: Optional["UiServer"] = None
 
     def __init__(self, port: int = 0):
         self.history = HistoryStorage()
         self.flow = SessionStorage()
+        self.tsne = SessionStorage()
+        self._nn_trees = {}
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -99,6 +150,17 @@ class UiServer:
                     return self._json(server.history.latest(sid))
                 if url.path == "/flow/data":
                     return self._json(server.flow.get(sid, "model"))
+                if url.path == "/tsne":
+                    return self._html(_TSNE_PAGE)
+                if url.path == "/tsne/data":
+                    return self._json(server.tsne.get(sid, "coords")
+                                      or {"coords": [], "labels": []})
+                if url.path == "/nearestneighbors":
+                    return self._html(_NN_PAGE)
+                if url.path == "/nearestneighbors/search":
+                    word = q.get("word", [""])[0]
+                    k = int(q.get("k", ["10"])[0])
+                    return self._json(server._nn_search(sid, word, k))
                 return self._json({"error": "not found"}, 404)
 
             def do_POST(self):
@@ -113,12 +175,42 @@ class UiServer:
                 if url.path == "/flow/update":
                     server.flow.put(sid, "model", payload)
                     return self._json({"status": "ok"})
+                if url.path == "/tsne/update":
+                    server.tsne.put(sid, "coords",
+                                    {"coords": payload.get("coords", []),
+                                     "labels": payload.get("labels", [])})
+                    return self._json({"status": "ok"})
+                if url.path == "/nearestneighbors/update":
+                    server._nn_index(sid, payload.get("labels", []),
+                                     payload.get("vectors", []))
+                    return self._json({"status": "ok"})
                 return self._json({"error": "not found"}, 404)
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+
+    # -- nearest-neighbors view backend (VPTree, reference
+    # deeplearning4j-ui/.../nearestneighbors resource) -------------------------
+    def _nn_index(self, sid: str, labels, vectors) -> None:
+        import numpy as np
+        from ..clustering.trees import VPTree
+        arr = np.asarray(vectors, dtype=float)
+        self._nn_trees[sid] = (VPTree(arr, labels=list(labels)),
+                               {w: i for i, w in enumerate(labels)}, arr)
+
+    def _nn_search(self, sid: str, word: str, k: int) -> dict:
+        entry = self._nn_trees.get(sid)
+        if entry is None:
+            return {"error": "no index uploaded for session"}
+        tree, word_to_idx, arr = entry
+        if word not in word_to_idx:
+            return {"error": f"unknown word {word!r}"}
+        idxs, dists = tree.search(arr[word_to_idx[word]], k + 1)
+        out = [{"label": tree.labels[i], "distance": float(d)}
+               for i, d in zip(idxs, dists) if tree.labels[i] != word][:k]
+        return {"word": word, "neighbors": out}
 
     @classmethod
     def get_instance(cls, port: int = 0) -> "UiServer":

@@ -1,0 +1,105 @@
+"""Serving path: HTTP inference server + streaming train/serve routes.
+
+Round-trip acceptance (VERDICT r2 item 6): post CSV rows, receive
+predictions; train a net from a live stream; queue-fed inference route
+(reference DL4jServeRouteBuilder.java / SparkStreamingPipeline.java).
+"""
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+from deeplearning4j_tpu.models.zoo import mlp_iris
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (InferenceServer, QueueDataSetIterator,
+                                        RecordToDataSetConverter, ServeRoute,
+                                        StreamingTrainingPipeline)
+from deeplearning4j_tpu.util.model_serializer import write_model
+
+
+def _trained_iris_net():
+    iris = load_iris_dataset()
+    net = MultiLayerNetwork(mlp_iris()).init()
+    for _ in range(30):
+        net.fit_batch(iris.features, iris.labels)
+    return net, iris
+
+
+def test_http_server_roundtrip(tmp_path):
+    net, iris = _trained_iris_net()
+    # serve from a CHECKPOINT, like a real deployment
+    path = tmp_path / "model.zip"
+    write_model(net, path)
+    server = InferenceServer(model_path=path).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        health = json.loads(urllib.request.urlopen(base + "/health").read())
+        assert health["status"] == "ok" and health["params"] > 0
+
+        body = json.dumps({"data": iris.features[:8].tolist()}).encode()
+        req = urllib.request.Request(base + "/predict", data=body,
+                                     headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert len(out["predictions"]) == 8
+        expect = np.argmax(np.asarray(net.output(iris.features[:8])), -1)
+        assert out["classes"] == expect.tolist()
+
+        # CSV route
+        csv = "\n".join(",".join(f"{v:.3f}" for v in row)
+                        for row in iris.features[:5])
+        req = urllib.request.Request(base + "/predict/csv", data=csv.encode(),
+                                     headers={"Content-Type": "text/plain"})
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert len(out["classes"]) == 5
+
+        # malformed payload -> 400, server stays alive
+        req = urllib.request.Request(base + "/predict", data=b"not json")
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        assert json.loads(urllib.request.urlopen(base + "/health").read()
+                          )["status"] == "ok"
+    finally:
+        server.stop()
+
+
+def test_streaming_training_pipeline():
+    iris = load_iris_dataset()
+    net = MultiLayerNetwork(mlp_iris()).init()
+    conv = RecordToDataSetConverter(label_index=4, num_classes=3)
+    pipe = StreamingTrainingPipeline(net, conv).start()
+    rng = np.random.default_rng(0)
+    labels = np.argmax(iris.labels, -1)
+    for _ in range(20):  # producer: push raw records (features + label col)
+        idx = rng.integers(0, iris.features.shape[0], 32)
+        recs = [list(iris.features[i]) + [float(labels[i])] for i in idx]
+        pipe.push_records(recs)
+    pipe.finish()
+    assert net.step == 20
+    assert np.isfinite(net.score_)
+
+
+def test_queue_iterator_end_sentinel():
+    it = QueueDataSetIterator(batch_size=4, poll_timeout=0.2)
+    it.push(DataSet(np.zeros((4, 2), np.float32), np.zeros((4, 1), np.float32)))
+    it.end()
+    assert it.next_batch() is not None
+    assert it.next_batch() is None
+
+
+def test_serve_route_batches():
+    net, iris = _trained_iris_net()
+    got = []
+    route = ServeRoute(net, RecordToDataSetConverter(label_index=None),
+                       on_prediction=lambda out: got.append(out)).start()
+    for row in iris.features[:12]:
+        route.send([float(v) for v in row])
+    route.stop()
+    preds = np.concatenate(got)
+    assert preds.shape == (12, 3)
+    expect = np.argmax(np.asarray(net.output(iris.features[:12])), -1)
+    np.testing.assert_array_equal(np.argmax(preds, -1), expect)
