@@ -140,11 +140,12 @@ def main() -> None:
     ys = jnp.asarray(np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))])
     _bench_net("char_rnn_lstm", char_rnn_lstm(dtype=dtype), xs, ys,
                B, 2, 256, dtype)
-    if on_tpu:  # fused Pallas LSTM behind the helper seam (cuDNN analog)
+    if on_tpu:  # helper seam with per-shape autotuned Pallas LSTM (cuDNN
+        # analog) — SAME dtype as the XLA baseline (apples-to-apples)
         pallas_kernels.enable(interpret=False)
         try:
-            _bench_net("char_rnn_lstm_pallas", char_rnn_lstm(dtype="float32"),
-                       xs, ys, B, 2, 256, "float32")
+            _bench_net("char_rnn_lstm_pallas", char_rnn_lstm(dtype=dtype),
+                       xs, ys, B, 2, 256, dtype)
             WORKLOADS["char_rnn_lstm_pallas"]["helper_delta_vs_xla"] = round(
                 WORKLOADS["char_rnn_lstm_pallas"]["examples_per_sec"]
                 / WORKLOADS["char_rnn_lstm"]["examples_per_sec"], 3)

@@ -359,6 +359,37 @@ class SequenceVectors:
         B = self.batch_size
         last_loss = float("nan")
         tokens_seen = 0
+        # warm the jitted steps on dummy batches so words_per_sec_ reports
+        # STEADY-STATE throughput (compile excluded — it amortizes to zero
+        # on reference-scale corpora; tables are unchanged by the warmup)
+        zi = jnp.zeros((B,), jnp.int32)
+        zv = jnp.zeros((B,), jnp.float32)
+        lr0 = np.float32(self.learning_rate)
+        if step_neg is not None and not self.cbow:
+            step_neg(table.syn0, table.syn1neg, put_b(zi), put_b(zi),
+                     put_b(jnp.zeros((B, self.negative), jnp.int32)),
+                     put_b(zv), lr0)
+        if step_hs is not None and not self.cbow:
+            Pmax = max(self._max_code_len, 1)
+            zp = jnp.zeros((B, Pmax), jnp.int32)
+            zc = jnp.zeros((B, Pmax), jnp.float32)
+            step_hs(table.syn0, table.syn1, put_b(zi), put_b(zp), put_b(zc),
+                    put_b(zc), put_b(zv), lr0)
+        if step_cbow is not None:
+            zw = jnp.zeros((B, 2 * self.window), jnp.int32)
+            zm = jnp.zeros((B, 2 * self.window), jnp.float32)
+            step_cbow(table.syn0, table.syn1neg, put_b(zi), put_b(zw),
+                      put_b(zm), put_b(jnp.zeros((B, self.negative),
+                                                 jnp.int32)),
+                      put_b(zv), lr0)
+        if step_cbow_hs is not None:
+            Pmax = max(self._max_code_len, 1)
+            zw = jnp.zeros((B, 2 * self.window), jnp.int32)
+            zm = jnp.zeros((B, 2 * self.window), jnp.float32)
+            zp = jnp.zeros((B, Pmax), jnp.int32)
+            zc = jnp.zeros((B, Pmax), jnp.float32)
+            step_cbow_hs(table.syn0, table.syn1, put_b(zw), put_b(zm),
+                         put_b(zp), put_b(zc), put_b(zc), put_b(zv), lr0)
         t0 = _time.perf_counter()
         for _ in range(self.epochs):
             order = rng.permutation(len(encoded))

@@ -119,10 +119,12 @@ class TrainingStateTracker:
         """Restore the newest INTACT checkpoint into `net` (a kill during
         save leaves a .tmp which is ignored; a torn final file falls back to
         the previous checkpoint). Returns the cursor or None."""
+        import zlib
         for path in reversed(self._checkpoint_paths()):
             try:
                 return self._restore_one(net, path)
-            except (zipfile.BadZipFile, KeyError, OSError, ValueError):
+            except (zipfile.BadZipFile, KeyError, OSError, ValueError,
+                    zlib.error):  # torn OR bit-corrupted file -> fall back
                 continue
         return None
 

@@ -36,6 +36,7 @@ class RecordToDataSetConverter:
         self.label_index = label_index
         self.num_classes = num_classes
         self.regression = regression
+        self._inferred: Optional[int] = None  # locked on first batch
 
     def convert(self, records: Sequence[Sequence]) -> DataSet:
         rows = [[float(v) for v in r] for r in records]
@@ -48,7 +49,16 @@ class RecordToDataSetConverter:
         if self.regression:
             y = labels[:, None]
         else:
-            n = self.num_classes or int(labels.max()) + 1
+            # inference is locked to the FIRST batch so streamed batches all
+            # produce the same one-hot width (a later batch missing some
+            # class must not shrink the label shape mid-stream)
+            n = self.num_classes or self._inferred
+            if n is None:
+                n = self._inferred = int(labels.max()) + 1
+            if labels.max() >= n:
+                raise ValueError(
+                    f"label {int(labels.max())} >= num_classes {n}; pass "
+                    "num_classes explicitly for streamed data")
             y = np.eye(n, dtype=np.float32)[labels.astype(np.int64)]
         return DataSet(feats, y)
 
@@ -203,6 +213,8 @@ class ServeRoute:
         return self
 
     def send(self, record: Sequence) -> None:
+        if self.error is not None:  # fail fast: don't enqueue into a dead route
+            raise RuntimeError("ServeRoute consumer died") from self.error
         self._queue.put(list(record))
 
     def stop(self, timeout: float = 30.0) -> None:

@@ -159,7 +159,11 @@ class UiServer:
                     return self._html(_NN_PAGE)
                 if url.path == "/nearestneighbors/search":
                     word = q.get("word", [""])[0]
-                    k = int(q.get("k", ["10"])[0])
+                    try:
+                        k = int(q.get("k", ["10"])[0])
+                    except ValueError:
+                        return self._json({"error": "k must be an integer"},
+                                          400)
                     return self._json(server._nn_search(sid, word, k))
                 return self._json({"error": "not found"}, 404)
 
