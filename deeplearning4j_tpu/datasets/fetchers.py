@@ -36,17 +36,39 @@ def one_hot(labels: np.ndarray, n_classes: int) -> np.ndarray:
 # -- IDX format (reference datasets/mnist/MnistDbFile + friends) ---------------
 
 def read_idx(path: Path) -> np.ndarray:
-    """Read an IDX-format file (optionally gzipped)."""
+    """Read an IDX-format file (optionally gzipped) preserving its dtype."""
+    import io
     opener = gzip.open if str(path).endswith(".gz") else open
     with opener(path, "rb") as f:
-        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
-        if zero != 0:
-            raise ValueError(f"{path}: bad IDX magic")
-        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
-        dtype = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
-                 0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}[dtype_code]
-        data = np.frombuffer(f.read(), dtype=dtype.newbyteorder(">"))
-        return data.reshape(dims)
+        data = f.read()
+    return _read_idx_py(io.BytesIO(data))
+
+
+def read_idx_f32(path: Path, scale: float = 1.0) -> np.ndarray:
+    """Read a u8 IDX file directly to scaled float32. Uses the C++ host
+    runtime's fused decode+normalize loop when built (native/lib.py — the
+    role the reference's native MnistImageFile reader plays); falls back to
+    read_idx + astype."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    if len(data) >= 4 and data[0] == 0 and data[1] == 0 and data[2] == 0x08:
+        from ..native.lib import decode_idx, native_available
+        if native_available():
+            return decode_idx(data, scale=scale)
+    import io
+    return _read_idx_py(io.BytesIO(data)).astype(np.float32) * scale
+
+
+def _read_idx_py(f) -> np.ndarray:
+    zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+    if zero != 0:
+        raise ValueError("bad IDX magic")
+    dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+    dtype = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+             0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}[dtype_code]
+    data = np.frombuffer(f.read(), dtype=np.dtype(dtype).newbyteorder(">"))
+    return data.reshape(dims)
 
 
 # -- Iris ----------------------------------------------------------------------
@@ -121,7 +143,7 @@ def load_mnist(num: int = 60000, train: bool = True, binarize: bool = False) -> 
     found = _find_mnist(train)
     if found is None:
         return _digits_as_mnist(num, train, binarize)
-    images = read_idx(found[0]).astype(np.float32) / 255.0
+    images = read_idx_f32(found[0], scale=1.0 / 255.0)
     labels = read_idx(found[1])
     images, labels = images[:num], labels[:num]
     if binarize:
@@ -175,3 +197,107 @@ class CifarDataSetIterator(ListDataSetIterator):
 
     def __init__(self, batch: int, num_examples: int = 50000, train: bool = True):
         super().__init__(load_cifar10(num_examples, train), batch)
+
+
+# -- LFW (Labeled Faces in the Wild) -------------------------------------------
+
+def load_lfw(num: int = 1000, height: int = 28, width: int = 28,
+             num_people: int = 20, seed: int = 42) -> DataSet:
+    """LFW faces (reference datasets/fetchers/LFWDataFetcher.java, which
+    auto-downloads the tarball). Zero-egress environments: loads from
+    `data_dir()/lfw/<person>/<img>` if present (same layout the reference
+    extracts), else falls back to sklearn's bundled LFW cache if available,
+    else a deterministic synthetic face-like dataset (per-person base
+    pattern + noise) so pipelines stay runnable offline."""
+    base = data_dir() / "lfw"
+    if base.is_dir():
+        people = sorted(p for p in base.iterdir() if p.is_dir())[:num_people]
+        xs, ys = [], []
+        for label, person in enumerate(people):
+            for img_path in sorted(person.glob("*")):
+                try:
+                    from PIL import Image
+                    img = Image.open(img_path).convert("L").resize(
+                        (width, height))
+                    xs.append(np.asarray(img, np.float32) / 255.0)
+                    ys.append(label)
+                except Exception:
+                    continue
+                if len(xs) >= num:
+                    break
+            if len(xs) >= num:
+                break
+        if xs:
+            x = np.stack(xs)
+            return DataSet(x.reshape(len(xs), -1),
+                           one_hot(np.asarray(ys), len(people)))
+    try:
+        from sklearn.datasets import fetch_lfw_people
+        d = fetch_lfw_people(min_faces_per_person=20, resize=0.4,
+                             download_if_missing=False)
+        # honor the requested geometry/classes: cap to the num_people most
+        # frequent identities and resample images to (height, width)
+        people = np.argsort(-np.bincount(d.target))[:num_people]
+        remap = {int(p): i for i, p in enumerate(people)}
+        keep = np.isin(d.target, people)
+        imgs = d.images[keep][:num].astype(np.float32)
+        y = np.asarray([remap[int(t)] for t in d.target[keep][:num]])
+        ih, iw = imgs.shape[1:]
+        ri = (np.arange(height) * ih // height)[:, None]
+        ci = (np.arange(width) * iw // width)[None, :]
+        x = imgs[:, ri, ci]  # nearest-neighbour resample
+        return DataSet(x.reshape(x.shape[0], -1), one_hot(y, num_people))
+    except Exception:
+        pass
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, num_people, num)
+    base_faces = rng.normal(0.5, 0.2, (num_people, height, width)).astype(np.float32)
+    # smooth the base patterns a little so they're image-like
+    base_faces = (base_faces + np.roll(base_faces, 1, 1)
+                  + np.roll(base_faces, 1, 2)) / 3.0
+    x = np.clip(base_faces[y] + rng.normal(0, 0.1, (num, height, width))
+                .astype(np.float32), 0, 1)
+    return DataSet(x.reshape(num, -1), one_hot(y, num_people))
+
+
+class LFWDataSetIterator(ListDataSetIterator):
+    """Reference datasets/iterator/impl/LFWDataSetIterator."""
+
+    def __init__(self, batch: int, num_examples: int = 1000,
+                 height: int = 28, width: int = 28, num_people: int = 20):
+        super().__init__(load_lfw(num_examples, height, width, num_people),
+                         batch)
+
+
+# -- Curves --------------------------------------------------------------------
+
+def load_curves(num: int = 10000, size: int = 28, seed: int = 7) -> DataSet:
+    """Curves dataset (reference datasets/fetchers/CurvesDataFetcher.java,
+    which downloads a serialized DataSet of synthetic curve images used for
+    autoencoder pretraining benchmarks). Generated deterministically here:
+    random cubic-spline-like strokes rasterized to [size, size], labels =
+    the curve's dominant direction octant. Features==reconstruction target
+    semantics preserved (it is an unsupervised pretraining set)."""
+    rng = np.random.default_rng(seed)
+    xs = np.zeros((num, size, size), np.float32)
+    ys = np.zeros(num, np.int64)
+    t = np.linspace(0.0, 1.0, 64)
+    for i in range(num):
+        p = rng.uniform(0.15, 0.85, (4, 2))  # control points
+        # cubic Bezier
+        curve = ((1 - t)[:, None] ** 3 * p[0] + 3 * (1 - t)[:, None] ** 2
+                 * t[:, None] * p[1] + 3 * (1 - t)[:, None] * t[:, None] ** 2
+                 * p[2] + t[:, None] ** 3 * p[3])
+        pix = np.clip((curve * size).astype(int), 0, size - 1)
+        xs[i, pix[:, 1], pix[:, 0]] = 1.0
+        d = p[3] - p[0]
+        ys[i] = int(np.floor((np.arctan2(d[1], d[0]) + np.pi)
+                             / (np.pi / 4))) % 8
+    return DataSet(xs.reshape(num, -1), one_hot(ys, 8))
+
+
+class CurvesDataSetIterator(ListDataSetIterator):
+    """Reference datasets/iterator/CurvesDataSetIterator."""
+
+    def __init__(self, batch: int, num_examples: int = 10000):
+        super().__init__(load_curves(num_examples), batch)

@@ -273,3 +273,119 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 idx = l.reshape(-1).astype(int)
                 y[i, np.arange(len(idx)), idx] = 1.0
         return DataSet(x, y, features_mask=mask, labels_mask=mask)
+
+
+class RecordReaderMultiDataSetIterator(DataSetIterator):
+    """Multi-input/multi-output vectorization for ComputationGraph training
+    (reference datasets/canova/RecordReaderMultiDataSetIterator.java): named
+    record readers advance in lockstep; column-range specs route record
+    slices into the MultiDataSet's inputs/outputs (one-hot or regression).
+
+    Build with the fluent builder, mirroring the reference:
+        it = (RecordReaderMultiDataSetIterator.builder(batch_size=16)
+              .add_reader("csv", reader)
+              .add_input("csv", 0, 3)                 # cols 0..3 inclusive
+              .add_output_one_hot("csv", 4, 3)        # col 4 -> 3 classes
+              .build())
+    """
+
+    def __init__(self, batch_size: int, readers, inputs, outputs):
+        self._batch = batch_size
+        self._readers = readers            # name -> RecordReader
+        self._inputs = inputs              # [(reader, first, last)]
+        self._outputs = outputs            # [(reader, first, last, n_cls)]
+
+    class Builder:
+        def __init__(self, batch_size: int):
+            self._batch = batch_size
+            self._readers = {}
+            self._inputs = []
+            self._outputs = []
+
+        def add_reader(self, name: str, reader: RecordReader):
+            self._readers[name] = reader
+            return self
+
+        def add_input(self, name: str, first_col: Optional[int] = None,
+                      last_col: Optional[int] = None):
+            self._inputs.append((name, first_col, last_col))
+            return self
+
+        def add_output(self, name: str, first_col: Optional[int] = None,
+                       last_col: Optional[int] = None):
+            self._outputs.append((name, first_col, last_col, None))
+            return self
+
+        def add_output_one_hot(self, name: str, col: int, num_classes: int):
+            self._outputs.append((name, col, col, num_classes))
+            return self
+
+        def build(self) -> "RecordReaderMultiDataSetIterator":
+            missing = {n for n, *_ in self._inputs + self._outputs} \
+                - set(self._readers)
+            if missing:
+                raise ValueError(f"specs reference unknown readers {missing}")
+            return RecordReaderMultiDataSetIterator(
+                self._batch, self._readers, self._inputs, self._outputs)
+
+    @staticmethod
+    def builder(batch_size: int) -> "RecordReaderMultiDataSetIterator.Builder":
+        return RecordReaderMultiDataSetIterator.Builder(batch_size)
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def reset(self):
+        for r in self._readers.values():
+            r.reset()
+
+    def _pull_rows(self):
+        """One row from EVERY reader, or None when any is exhausted. Values
+        stay raw here — only the columns a spec routes get float-converted,
+        so unreferenced columns (string ids, free text) are legal."""
+        rows = {}
+        for name, r in self._readers.items():
+            if not r.has_next():
+                return None
+            rec = r.next_record()
+            if rec is None:
+                return None
+            rows[name] = list(rec)
+        return rows
+
+    def next_batch(self):
+        from .dataset import MultiDataSet
+        batch_rows = []
+        while len(batch_rows) < self._batch:
+            rows = self._pull_rows()
+            if rows is None:
+                break
+            batch_rows.append(rows)
+        if not batch_rows:
+            return None
+
+        def slice_cols(spec_rows, name, first, last):
+            row0 = spec_rows[0][name]
+            f = 0 if first is None else first
+            l = len(row0) - 1 if last is None else last
+            return np.asarray([[float(v) for v in r[name][f:l + 1]]
+                               for r in spec_rows], np.float32)
+
+        inputs = [slice_cols(batch_rows, n, f, l) for n, f, l in self._inputs]
+        outputs = []
+        for n, f, l, n_cls in self._outputs:
+            arr = slice_cols(batch_rows, n, f, l)
+            if n_cls is not None:
+                arr = one_hot(arr.reshape(-1), n_cls)
+            outputs.append(arr)
+        return MultiDataSet(inputs, outputs)
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        mds = self.next_batch()
+        if mds is None:
+            raise StopIteration
+        return mds

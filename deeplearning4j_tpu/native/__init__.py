@@ -1,0 +1,16 @@
+"""nd4j-tpu: the pluggable tensor seam + the C++ host runtime.
+
+Two halves, mirroring the reference's native layer (SURVEY.md §2.1):
+  - `ndarray` — the INDArray/Nd4j/Transforms op surface over a swappable
+    Backend (JAX/XLA by default), for users porting reference-style code
+  - `lib` — the compiled C++ data-path runtime (IDX/CSV decode, staging
+    buffer pool) with NumPy fallback when no toolchain is present
+"""
+from .ndarray import (Backend, JaxBackend, NDArray, Nd4j, Transforms,
+                      get_backend, set_backend)
+from .lib import (StagingBuffer, decode_csv, decode_idx, native_available,
+                  staging_stats)
+
+__all__ = ["Backend", "JaxBackend", "NDArray", "Nd4j", "Transforms",
+           "get_backend", "set_backend", "StagingBuffer", "decode_csv",
+           "decode_idx", "native_available", "staging_stats"]

@@ -1,0 +1,414 @@
+"""The nd4j-tpu tensor seam: an INDArray-style op surface over a pluggable
+backend.
+
+SURVEY.md §2.1 names this as the reference's load-bearing seam — core code
+written against `INDArray`/`Nd4j` runs on whichever backend is on the
+classpath (nd4j-native C++ loops or nd4j-cuda). This module is that seam's
+TPU-native equivalent, sized to the §2.1 import census: factory ops
+(zeros/ones/rand/randn/create/arange/linspace), gemm/mmul, elementwise
+transforms (`Transforms`), reductions, indexing/views, and in-place `*i`
+ops — with the crucial semantic translation that ND4J's MUTATING ops
+(`addi`/`divi`, views into flat buffers) become REBINDING ops on immutable
+XLA buffers: `a.addi(b)` computes functionally and repoints `a`'s handle,
+preserving call-site semantics while staying jit/donation-friendly.
+
+The framework's own layers intentionally use jnp directly — inside jit a
+functional style is strictly better — but this surface is the PUBLIC
+array API for users porting reference code, and the Backend SPI is the
+point where a different tensor engine could be swapped in.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+
+class Backend:
+    """Tensor-backend SPI (the nd4j-native / nd4j-cuda / nd4j-tpu seam).
+    All ops take/return backend-native buffers."""
+
+    name = "abstract"
+
+    def asarray(self, data, dtype):  # noqa: D102
+        raise NotImplementedError
+
+    def to_numpy(self, buf) -> np.ndarray:
+        raise NotImplementedError
+
+    def gemm(self, a, b):
+        raise NotImplementedError
+
+    def elementwise(self, op: str, *bufs):
+        raise NotImplementedError
+
+    def reduce(self, op: str, buf, axis):
+        raise NotImplementedError
+
+    def rand(self, shape, seed, dist: str, **kw):
+        raise NotImplementedError
+
+
+class JaxBackend(Backend):
+    """XLA-lowered backend: every op dispatches to jax.numpy (compiled,
+    TPU-resident). The analog of nd4j-cuda being 'on the classpath'."""
+
+    name = "jax"
+
+    _ELEMENTWISE = None
+
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+        self._jax = jax
+        self._jnp = jnp
+        if JaxBackend._ELEMENTWISE is None:
+            JaxBackend._ELEMENTWISE = {
+                "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+                "div": jnp.divide, "pow": jnp.power, "neg": jnp.negative,
+                "exp": jnp.exp, "log": jnp.log, "sqrt": jnp.sqrt,
+                "abs": jnp.abs, "sign": jnp.sign, "floor": jnp.floor,
+                "ceil": jnp.ceil, "round": jnp.round,
+                "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+                "relu": jax.nn.relu, "softmax": jax.nn.softmax,
+                "maximum": jnp.maximum, "minimum": jnp.minimum,
+            }
+
+    def asarray(self, data, dtype):
+        return self._jnp.asarray(data, dtype)
+
+    def to_numpy(self, buf):
+        return np.asarray(buf)
+
+    def gemm(self, a, b):
+        return self._jnp.matmul(a, b)
+
+    def elementwise(self, op, *bufs):
+        fn = JaxBackend._ELEMENTWISE.get(op)
+        if fn is None:
+            raise ValueError(f"unknown elementwise op {op!r}")
+        return fn(*bufs)
+
+    def reduce(self, op, buf, axis):
+        jnp = self._jnp
+        fns = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max,
+               "min": jnp.min, "prod": jnp.prod, "std": jnp.std,
+               "var": jnp.var, "argmax": jnp.argmax, "argmin": jnp.argmin,
+               "norm2": lambda a, axis=None: jnp.sqrt(jnp.sum(a * a, axis)),
+               "norm1": lambda a, axis=None: jnp.sum(jnp.abs(a), axis)}
+        return fns[op](buf, axis=axis)
+
+    def rand(self, shape, seed, dist, **kw):
+        jax = self._jax
+        key = jax.random.PRNGKey(seed)
+        if dist == "uniform":
+            return jax.random.uniform(key, shape, minval=kw.get("low", 0.0),
+                                      maxval=kw.get("high", 1.0))
+        if dist == "normal":
+            return (kw.get("mean", 0.0)
+                    + kw.get("std", 1.0) * jax.random.normal(key, shape))
+        if dist == "binomial":
+            return jax.random.bernoulli(
+                key, kw.get("p", 0.5), shape).astype(self._jnp.float32)
+        raise ValueError(f"unknown distribution {dist!r}")
+
+
+_backend: Optional[Backend] = None
+
+
+def get_backend() -> Backend:
+    global _backend
+    if _backend is None:
+        _backend = JaxBackend()
+    return _backend
+
+
+def set_backend(backend: Backend) -> None:
+    """Swap the tensor engine (the classpath-swap analog)."""
+    global _backend
+    _backend = backend
+
+
+class NDArray:
+    """INDArray-style handle. Arithmetic returns new NDArrays; `*i` ops
+    rebind this handle in place (see module docstring)."""
+
+    __array_priority__ = 100
+
+    def __init__(self, buf):
+        self._buf = buf
+
+    # -- basics ---------------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._buf.shape)
+
+    @property
+    def dtype(self):
+        return self._buf.dtype
+
+    def rank(self) -> int:
+        return self._buf.ndim
+
+    def length(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def to_numpy(self) -> np.ndarray:
+        return get_backend().to_numpy(self._buf)
+
+    def unwrap(self):
+        """The raw backend buffer (jax.Array on the default backend)."""
+        return self._buf
+
+    def dup(self) -> "NDArray":
+        return NDArray(get_backend().elementwise("add", self._buf, 0))
+
+    def __repr__(self):
+        return f"NDArray{self.shape}({self.to_numpy()!r})"
+
+    # -- shape ops ------------------------------------------------------------
+    def reshape(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(self._buf.reshape(shape))
+
+    def transpose(self, *axes) -> "NDArray":
+        return NDArray(self._buf.transpose(*axes) if axes
+                       else self._buf.T)
+
+    def ravel(self) -> "NDArray":
+        return NDArray(self._buf.reshape(-1))
+
+    def broadcast_to(self, shape) -> "NDArray":
+        import jax.numpy as jnp
+        return NDArray(jnp.broadcast_to(self._buf, shape))
+
+    # -- indexing/views (NDArrayIndex analog) ---------------------------------
+    def __getitem__(self, idx) -> "NDArray":
+        return NDArray(self._buf[idx])
+
+    def put(self, idx, value) -> "NDArray":
+        """Functional scatter that REBINDS this handle — the view-write
+        translation of INDArray.put."""
+        v = value._buf if isinstance(value, NDArray) else value
+        self._buf = self._buf.at[idx].set(v)
+        return self
+
+    def get_scalar(self, *idx) -> float:
+        return float(self._buf[idx])
+
+    # -- arithmetic -----------------------------------------------------------
+    def _coerce(self, other):
+        if isinstance(other, NDArray):
+            return other._buf
+        return other
+
+    def _bin(self, op, other) -> "NDArray":
+        return NDArray(get_backend().elementwise(op, self._buf,
+                                                 self._coerce(other)))
+
+    def add(self, o):  # noqa: D102
+        return self._bin("add", o)
+
+    def sub(self, o):
+        return self._bin("sub", o)
+
+    def mul(self, o):
+        return self._bin("mul", o)
+
+    def div(self, o):
+        return self._bin("div", o)
+
+    def rsub(self, o):
+        return NDArray(get_backend().elementwise(
+            "sub", self._coerce(o), self._buf))
+
+    def rdiv(self, o):
+        return NDArray(get_backend().elementwise(
+            "div", self._coerce(o), self._buf))
+
+    def neg(self):
+        return NDArray(get_backend().elementwise("neg", self._buf))
+
+    # in-place (*i) family: rebind the handle
+    def addi(self, o):
+        self._buf = self._bin("add", o)._buf
+        return self
+
+    def subi(self, o):
+        self._buf = self._bin("sub", o)._buf
+        return self
+
+    def muli(self, o):
+        self._buf = self._bin("mul", o)._buf
+        return self
+
+    def divi(self, o):
+        self._buf = self._bin("div", o)._buf
+        return self
+
+    def assign(self, o):
+        b = self._coerce(o)
+        import jax.numpy as jnp
+        self._buf = jnp.broadcast_to(jnp.asarray(b), self.shape).astype(
+            self.dtype)
+        return self
+
+    __add__ = add
+    __sub__ = sub
+    __mul__ = mul
+    __truediv__ = div
+    __radd__ = add
+    __rmul__ = mul
+    __rsub__ = rsub
+    __rtruediv__ = rdiv
+    __neg__ = neg
+
+    def __matmul__(self, o):
+        return self.mmul(o)
+
+    # -- linalg ---------------------------------------------------------------
+    def mmul(self, other) -> "NDArray":
+        return NDArray(get_backend().gemm(self._buf, self._coerce(other)))
+
+    # -- reductions -----------------------------------------------------------
+    def _red(self, op, axis=None) -> Union["NDArray", float]:
+        out = get_backend().reduce(op, self._buf, axis)
+        if axis is None and op not in ("argmax", "argmin"):
+            return float(out)
+        return NDArray(out) if hasattr(out, "shape") and out.shape \
+            else (int(out) if op in ("argmax", "argmin") else float(out))
+
+    def sum(self, axis=None):
+        return self._red("sum", axis)
+
+    def mean(self, axis=None):
+        return self._red("mean", axis)
+
+    def max(self, axis=None):
+        return self._red("max", axis)
+
+    def min(self, axis=None):
+        return self._red("min", axis)
+
+    def std(self, axis=None):
+        return self._red("std", axis)
+
+    def var(self, axis=None):
+        return self._red("var", axis)
+
+    def prod(self, axis=None):
+        return self._red("prod", axis)
+
+    def norm1(self, axis=None):
+        return self._red("norm1", axis)
+
+    def norm2(self, axis=None):
+        return self._red("norm2", axis)
+
+    def argmax(self, axis=None):
+        return self._red("argmax", axis)
+
+
+class Transforms:
+    """Reference org.nd4j.linalg.ops.transforms.Transforms statics."""
+
+    @staticmethod
+    def _un(op, a: NDArray) -> NDArray:
+        return NDArray(get_backend().elementwise(op, a._buf))
+
+    sigmoid = staticmethod(lambda a: Transforms._un("sigmoid", a))
+    tanh = staticmethod(lambda a: Transforms._un("tanh", a))
+    relu = staticmethod(lambda a: Transforms._un("relu", a))
+    exp = staticmethod(lambda a: Transforms._un("exp", a))
+    log = staticmethod(lambda a: Transforms._un("log", a))
+    sqrt = staticmethod(lambda a: Transforms._un("sqrt", a))
+    abs = staticmethod(lambda a: Transforms._un("abs", a))
+    sign = staticmethod(lambda a: Transforms._un("sign", a))
+    floor = staticmethod(lambda a: Transforms._un("floor", a))
+    round = staticmethod(lambda a: Transforms._un("round", a))
+    softmax = staticmethod(lambda a: Transforms._un("softmax", a))
+
+    @staticmethod
+    def pow(a: NDArray, p) -> NDArray:
+        return a._bin("pow", p)
+
+    @staticmethod
+    def max(a: NDArray, b) -> NDArray:
+        return a._bin("maximum", b)
+
+    @staticmethod
+    def min(a: NDArray, b) -> NDArray:
+        return a._bin("minimum", b)
+
+
+class Nd4j:
+    """Reference org.nd4j.linalg.factory.Nd4j statics."""
+
+    _default_dtype = np.float32
+
+    @staticmethod
+    def create(data, shape: Optional[Sequence[int]] = None) -> NDArray:
+        arr = get_backend().asarray(data, Nd4j._default_dtype)
+        if shape is not None:
+            arr = arr.reshape(tuple(shape))
+        return NDArray(arr)
+
+    @staticmethod
+    def zeros(*shape) -> NDArray:
+        return Nd4j.create(np.zeros(_norm_shape(shape), np.float32))
+
+    @staticmethod
+    def ones(*shape) -> NDArray:
+        return Nd4j.create(np.ones(_norm_shape(shape), np.float32))
+
+    @staticmethod
+    def valueArrayOf(shape, value) -> NDArray:  # noqa: N802 (reference name)
+        return Nd4j.create(np.full(_norm_shape(shape), value, np.float32))
+
+    @staticmethod
+    def eye(n: int) -> NDArray:
+        return Nd4j.create(np.eye(n, dtype=np.float32))
+
+    @staticmethod
+    def arange(*args) -> NDArray:
+        return Nd4j.create(np.arange(*args).astype(np.float32))
+
+    @staticmethod
+    def linspace(start, stop, num) -> NDArray:
+        return Nd4j.create(np.linspace(start, stop, num, dtype=np.float32))
+
+    @staticmethod
+    def rand(*shape, seed: int = 0) -> NDArray:
+        return NDArray(get_backend().rand(_norm_shape(shape), seed,
+                                          "uniform"))
+
+    @staticmethod
+    def randn(*shape, seed: int = 0) -> NDArray:
+        return NDArray(get_backend().rand(_norm_shape(shape), seed,
+                                          "normal"))
+
+    @staticmethod
+    def gemm(a: NDArray, b: NDArray) -> NDArray:
+        return a.mmul(b)
+
+    @staticmethod
+    def hstack(*arrays) -> NDArray:
+        import jax.numpy as jnp
+        return NDArray(jnp.concatenate([a._buf for a in arrays], axis=-1))
+
+    @staticmethod
+    def vstack(*arrays) -> NDArray:
+        import jax.numpy as jnp
+        return NDArray(jnp.concatenate([a._buf for a in arrays], axis=0))
+
+    @staticmethod
+    def concat(axis: int, *arrays) -> NDArray:
+        import jax.numpy as jnp
+        return NDArray(jnp.concatenate([a._buf for a in arrays], axis=axis))
+
+
+def _norm_shape(shape) -> Tuple[int, ...]:
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        return tuple(shape[0])
+    return tuple(shape)

@@ -395,6 +395,8 @@ class MultiLayerNetwork:
         """fit(DataSetIterator) | fit(DataSet) | fit(x, y).
         Mirrors MultiLayerNetwork.fit(DataSetIterator):1013."""
         self._check_init()
+        from ..util.heartbeat import report_event
+        report_event("standalone_fit", self)  # MultiLayerNetwork.java:52-56
         if labels is not None:
             self._fit_one(jnp.asarray(data), jnp.asarray(labels), None, None)
             return self

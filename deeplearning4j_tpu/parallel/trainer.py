@@ -49,6 +49,13 @@ class TrainingMaster:
 
 
 def _tree_put(tree, sharding):
+    if jax.process_count() > 1:
+        # multi-process (multi-host): route through host memory — a
+        # process-local jax.Array source is not addressable everywhere,
+        # but every process can contribute shards from the same numpy value
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.asarray(a), sharding), tree)
+    # single-process: direct device-to-device resharding (often a no-op)
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)
 
 
@@ -196,7 +203,8 @@ class IciDataParallelTrainingMaster(TrainingMaster):
                                                        fms, lms, n_dev)
 
                 def put(a):
-                    return (jax.device_put(jnp.asarray(a), shard)
+                    # numpy source: valid for global shardings multi-process
+                    return (jax.device_put(np.asarray(a), shard)
                             if a is not None else None)
                 xs = [put(a) for a in inputs]
                 ys = [put(a) for a in labels]

@@ -1,0 +1,97 @@
+"""Usage-telemetry heartbeat (reference `org.nd4j.linalg.heartbeat.Heartbeat`
+reported from MultiLayerNetwork.java:52-56 via TaskUtils: a periodic,
+opt-out environment+task ping).
+
+Zero-egress design: the report is assembled the same way (environment,
+device, task shape) but delivery is PLUGGABLE — the default sink is the
+process logger; deployments point `set_sink` at their metrics system. No
+network calls are ever made by default.
+"""
+from __future__ import annotations
+
+import logging
+import platform
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu.heartbeat")
+
+_SILENT = False
+_SINK: Optional[Callable[[Dict], None]] = None
+_last_beat: Dict[str, float] = {}
+_lock = threading.Lock()
+_MIN_INTERVAL_S = 3600.0  # at most one beat per task per hour, like ND4J
+
+
+def disable_heartbeat() -> None:
+    """Reference Heartbeat.disableHeartbeat()."""
+    global _SILENT
+    _SILENT = True
+
+
+def enable_heartbeat() -> None:
+    global _SILENT
+    _SILENT = False
+
+
+def set_sink(sink: Optional[Callable[[Dict], None]]) -> None:
+    """Route beats somewhere other than the logger (metrics pipe, file)."""
+    global _SINK
+    _SINK = sink
+
+
+def _reset_throttle() -> None:
+    """Testing hook: forget beat timestamps."""
+    with _lock:
+        _last_beat.clear()
+
+
+def build_environment() -> Dict:
+    """Reference EnvironmentUtils.buildEnvironment()."""
+    try:
+        import jax
+        backend = jax.default_backend()
+        n_devices = len(jax.devices())
+    except Exception:
+        backend, n_devices = "unknown", 0
+    return {
+        "os": platform.system(),
+        "python": platform.python_version(),
+        "backend": backend,
+        "num_devices": n_devices,
+    }
+
+
+def build_task(net) -> Dict:
+    """Reference TaskUtils.buildTask(model): coarse model shape."""
+    task: Dict = {"model": type(net).__name__}
+    try:
+        task["num_params"] = int(net.num_params())
+        layers = getattr(net.conf, "layers", None)
+        if layers is not None:
+            task["architecture"] = [type(l).__name__ for l in layers]
+    except Exception:
+        pass
+    return task
+
+
+def report_event(event: str, net=None) -> Optional[Dict]:
+    """Reference Heartbeat.reportEvent(Event, Environment, Task). Throttled
+    per (event, model-type); returns the beat that was emitted, or None."""
+    if _SILENT:
+        return None
+    key = f"{event}:{type(net).__name__ if net is not None else '-'}"
+    now = time.monotonic()
+    with _lock:
+        if now - _last_beat.get(key, -1e18) < _MIN_INTERVAL_S:
+            return None
+        _last_beat[key] = now
+    beat = {"event": event, "environment": build_environment()}
+    if net is not None:
+        beat["task"] = build_task(net)
+    if _SINK is not None:
+        _SINK(beat)
+    else:
+        logger.debug("heartbeat: %s", beat)
+    return beat

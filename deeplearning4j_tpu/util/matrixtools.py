@@ -1,0 +1,107 @@
+"""Matrix/sequence utilities: moving windows + Viterbi decoding.
+
+Parity with the reference `util/` grab bag:
+  - `MovingWindowMatrix.java` — all [window, window] sub-matrices of an
+    image/matrix (optionally rotated copies), used to window inputs
+  - `datasets/iterator/.../MovingWindowBaseDataSetIterator` — feeds those
+    windows as a DataSet stream
+  - `Viterbi.java` — max-product sequence decoding over a transition matrix
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..datasets.dataset import DataSet
+from ..datasets.iterators import DataSetIterator, ListDataSetIterator
+
+
+class MovingWindowMatrix:
+    """Reference util/MovingWindowMatrix.java: extract every stride-stepped
+    [wh, ww] window of a 2-D matrix; `add_rotate` appends the 3 extra 90°
+    rotations of each window."""
+
+    def __init__(self, to_slice: np.ndarray, window_height: int,
+                 window_width: Optional[int] = None, add_rotate: bool = False):
+        self.matrix = np.asarray(to_slice)
+        if self.matrix.ndim != 2:
+            raise ValueError("MovingWindowMatrix expects a 2-D matrix")
+        self.wh = window_height
+        self.ww = window_width or window_height
+        self.add_rotate = add_rotate
+
+    def windows(self, stride_h: Optional[int] = None,
+                stride_w: Optional[int] = None) -> List[np.ndarray]:
+        sh = stride_h or self.wh
+        sw = stride_w or self.ww
+        h, w = self.matrix.shape
+        out = []
+        for i in range(0, h - self.wh + 1, sh):
+            for j in range(0, w - self.ww + 1, sw):
+                win = self.matrix[i:i + self.wh, j:j + self.ww].copy()
+                out.append(win)
+                if self.add_rotate:
+                    for k in (1, 2, 3):
+                        out.append(np.rot90(win, k).copy())
+        return out
+
+
+class MovingWindowDataSetIterator(ListDataSetIterator):
+    """Window a batch of matrices into a DataSet stream (reference
+    MovingWindowBaseDataSetIterator): each window becomes one example whose
+    label is the source example's label."""
+
+    def __init__(self, data: DataSet, window_height: int, window_width: int,
+                 batch: int = 32, rows: Optional[int] = None,
+                 cols: Optional[int] = None):
+        x = np.asarray(data.features)
+        if x.ndim == 2:  # flat rows: need the source matrix shape
+            if rows is None or cols is None:
+                side = int(np.sqrt(x.shape[1]))
+                if side * side != x.shape[1]:
+                    raise ValueError("pass rows/cols for non-square inputs")
+                rows = cols = side
+            x = x.reshape(-1, rows, cols)
+        feats, labs = [], []
+        y = np.asarray(data.labels)
+        for i in range(x.shape[0]):
+            for win in MovingWindowMatrix(x[i], window_height,
+                                          window_width).windows():
+                feats.append(win.reshape(-1))
+                labs.append(y[i])
+        super().__init__(DataSet(np.asarray(feats, np.float32),
+                                 np.asarray(labs, np.float32)), batch)
+
+
+class Viterbi:
+    """Reference util/Viterbi.java: most-likely label sequence under a
+    Markov chain (log-space max-product)."""
+
+    def __init__(self, transition: np.ndarray,
+                 initial: Optional[np.ndarray] = None):
+        self.log_trans = np.log(np.maximum(np.asarray(transition, np.float64),
+                                           1e-300))
+        n = self.log_trans.shape[0]
+        init = (np.full(n, 1.0 / n) if initial is None
+                else np.asarray(initial, np.float64))
+        self.log_init = np.log(np.maximum(init, 1e-300))
+
+    def decode(self, emission_logprobs: np.ndarray
+               ) -> Tuple[np.ndarray, float]:
+        """emission_logprobs: [T, S] log p(obs_t | state). Returns
+        (best state path [T], its log-probability)."""
+        e = np.asarray(emission_logprobs, np.float64)
+        t_len, n = e.shape
+        delta = np.zeros((t_len, n))
+        psi = np.zeros((t_len, n), np.int64)
+        delta[0] = self.log_init + e[0]
+        for t in range(1, t_len):
+            scores = delta[t - 1][:, None] + self.log_trans  # [from, to]
+            psi[t] = np.argmax(scores, axis=0)
+            delta[t] = scores[psi[t], np.arange(n)] + e[t]
+        path = np.zeros(t_len, np.int64)
+        path[-1] = int(np.argmax(delta[-1]))
+        for t in range(t_len - 2, -1, -1):
+            path[t] = psi[t + 1][path[t + 1]]
+        return path, float(delta[-1].max())

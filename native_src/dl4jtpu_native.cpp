@@ -1,0 +1,204 @@
+// dl4jtpu_native: C++ host-side runtime for the TPU framework.
+//
+// The reference's runtime-critical host code is native (nd4j-native C++ op
+// loops + JavaCPP-managed buffers; Canova record decoding feeds them). On
+// TPU the device math belongs to XLA — what stays host-side and
+// latency-critical is the DATA PATH: decoding datasets and staging batches
+// for transfer. This library implements that path in C++:
+//
+//   - IDX decode (MNIST container format; reference datasets/mnist/ readers
+//     MnistImageFile/MnistLabelFile) straight into a caller-provided f32
+//     buffer, with the /255 normalization fused into the decode loop.
+//   - CSV float-matrix decode (Canova CSVRecordReader hot path) — a single
+//     pass, no per-field allocations.
+//   - A recycling aligned staging-buffer pool (the AffinityManager/JITA
+//     allocator analog, datasets/iterator/AsyncDataSetIterator.java:58-59):
+//     page-aligned host buffers reused across batches so the async prefetch
+//     path never churns the allocator.
+//
+// Exposed with C linkage for ctypes (no pybind11 in this image).
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// IDX decode
+// ---------------------------------------------------------------------------
+
+static uint32_t read_be32(const unsigned char* p) {
+    return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+           (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+// Parse an IDX header. Returns ndim (<=8) or -1 on error; fills dims.
+int idx_header(const unsigned char* buf, int64_t len, int64_t* dims,
+               int* dtype_code) {
+    if (len < 4) return -1;
+    if (buf[0] != 0 || buf[1] != 0) return -1;
+    *dtype_code = buf[2];
+    int ndim = buf[3];
+    if (ndim > 8 || len < 4 + 4 * (int64_t)ndim) return -1;
+    for (int i = 0; i < ndim; i++) dims[i] = read_be32(buf + 4 + 4 * i);
+    return ndim;
+}
+
+// Decode u8 IDX payload into float32, scaled by `scale` (pass 1/255 for
+// images, 1.0 for labels). Returns number of elements written, -1 on error.
+int64_t idx_decode_f32(const unsigned char* buf, int64_t len, float* out,
+                       int64_t out_len, float scale) {
+    int64_t dims[8];
+    int dtype;
+    int ndim = idx_header(buf, len, dims, &dtype);
+    if (ndim < 0 || dtype != 0x08) return -1;  // u8 payloads only
+    int64_t n = 1;
+    for (int i = 0; i < ndim; i++) n *= dims[i];
+    int64_t off = 4 + 4 * (int64_t)ndim;
+    if (len - off < n || out_len < n) return -1;
+    const unsigned char* p = buf + off;
+    for (int64_t i = 0; i < n; i++) out[i] = scale * (float)p[i];
+    return n;
+}
+
+// ---------------------------------------------------------------------------
+// CSV float-matrix decode
+// ---------------------------------------------------------------------------
+
+// Parse `rows` x `cols` floats from a delimited text buffer in ONE pass.
+// Returns number of values parsed, -1 on malformed input. STRICT field
+// grammar (agrees with the Python fallback): every delimiter-bounded field
+// on a non-empty line must parse as a float — an empty field is an error,
+// never silently skipped (silent skips would column-shift the matrix).
+int64_t csv_decode_f32(const char* buf, int64_t len, char delim, float* out,
+                       int64_t out_len) {
+    int64_t count = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+        // find the current line [p, eol)
+        const char* eol = p;
+        while (eol < end && *eol != '\n') eol++;
+        // blank (or whitespace-only) lines are ignored
+        const char* q = p;
+        while (q < eol && (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+        if (q < eol) {
+            // parse delimiter-separated fields strictly
+            const char* f = p;
+            while (f <= eol) {
+                const char* fe = f;
+                while (fe < eol && *fe != delim) fe++;
+                // trim the field
+                const char* a = f;
+                const char* b = fe;
+                while (a < b && (*a == ' ' || *a == '\t' || *a == '\r')) a++;
+                while (b > a && (*(b - 1) == ' ' || *(b - 1) == '\t' ||
+                                 *(b - 1) == '\r'))
+                    b--;
+                if (a == b) return -1;  // empty field
+                char* next = nullptr;
+                float v = strtof(a, &next);
+                if (next == a || next > b) return -1;
+                // trailing junk inside the field?
+                while (next < b && (*next == ' ' || *next == '\t')) next++;
+                if (next != b) return -1;
+                if (count >= out_len) return -1;
+                out[count++] = v;
+                if (fe >= eol) break;
+                f = fe + 1;
+            }
+        }
+        p = eol + 1;
+    }
+    return count;
+}
+
+// Count values and rows so the caller can size the output buffer.
+void csv_shape(const char* buf, int64_t len, char delim, int64_t* n_rows,
+               int64_t* n_vals) {
+    int64_t rows = 0, vals = 0;
+    int in_row = 0, in_field = 0;
+    for (int64_t i = 0; i < len; i++) {
+        char c = buf[i];
+        if (c == '\n') {
+            if (in_row) rows++;
+            if (in_field) vals++;
+            in_row = in_field = 0;
+        } else if (c == delim) {
+            if (in_field) vals++;
+            in_field = 0;
+        } else if (c != '\r' && c != ' ' && c != '\t') {
+            in_row = 1;
+            in_field = 1;
+        }
+    }
+    if (in_field) vals++;
+    if (in_row) rows++;
+    *n_rows = rows;
+    *n_vals = vals;
+}
+
+// ---------------------------------------------------------------------------
+// Staging buffer pool
+// ---------------------------------------------------------------------------
+
+namespace {
+struct Pool {
+    std::mutex mu;
+    // size -> free buffers of that size
+    std::multimap<int64_t, void*> free_list;
+    int64_t live = 0, reused = 0, allocated = 0;
+};
+Pool g_pool;
+constexpr int64_t kAlign = 4096;  // page-aligned: transfer-friendly
+}  // namespace
+
+void* staging_alloc(int64_t size) {
+    std::lock_guard<std::mutex> lock(g_pool.mu);
+    auto it = g_pool.free_list.lower_bound(size);
+    // reuse an existing buffer within 2x of the request
+    if (it != g_pool.free_list.end() && it->first <= 2 * size) {
+        void* buf = it->second;
+        g_pool.free_list.erase(it);
+        g_pool.live++;
+        g_pool.reused++;
+        return buf;
+    }
+    void* buf = nullptr;
+    if (posix_memalign(&buf, kAlign, (size_t)size) != 0) return nullptr;
+    g_pool.live++;
+    g_pool.allocated++;
+    return buf;
+}
+
+void staging_release(void* buf, int64_t size) {
+    if (!buf) return;
+    std::lock_guard<std::mutex> lock(g_pool.mu);
+    g_pool.live--;
+    if (g_pool.free_list.size() >= 16) {  // bounded pool
+        free(buf);
+        return;
+    }
+    g_pool.free_list.emplace(size, buf);
+}
+
+void staging_stats(int64_t* live, int64_t* reused, int64_t* allocated,
+                   int64_t* pooled) {
+    std::lock_guard<std::mutex> lock(g_pool.mu);
+    *live = g_pool.live;
+    *reused = g_pool.reused;
+    *allocated = g_pool.allocated;
+    *pooled = (int64_t)g_pool.free_list.size();
+}
+
+void staging_clear() {
+    std::lock_guard<std::mutex> lock(g_pool.mu);
+    for (auto& kv : g_pool.free_list) free(kv.second);
+    g_pool.free_list.clear();
+}
+
+}  // extern "C"
