@@ -138,3 +138,135 @@ class KDTree:
 
         visit(self._root)
         return best[0]
+
+
+class SpTree:
+    """n-dimensional space-partitioning tree (reference clustering/sptree/
+    SpTree.java — the octree generalization Barnes-Hut t-SNE uses; QuadTree
+    is its 2-D specialization).
+
+    Supports insertion, center-of-mass maintenance, and the Barnes-Hut
+    force accumulation `compute_non_edge_forces` with the theta cell-opening
+    criterion. The shipped BarnesHutTsne runs the exact chunked-MXU
+    repulsion instead (plot/tsne.py), so this structure exists for inventory
+    parity and host-side uses (it IS a faithful Barnes-Hut evaluator and is
+    tested against the exact sum)."""
+
+    __slots__ = ("center", "width", "dims", "cum_center", "cum_size",
+                 "point_index", "children", "_n_split", "_leaf_point")
+
+    def __init__(self, center: np.ndarray, width: np.ndarray):
+        self.center = np.asarray(center, np.float64)
+        self.width = np.asarray(width, np.float64)
+        self.dims = self.center.shape[0]
+        self.cum_center = np.zeros(self.dims)
+        self.cum_size = 0
+        self.point_index: Optional[int] = None  # leaf payload
+        self._leaf_point: Optional[np.ndarray] = None
+        self.children: Optional[List["SpTree"]] = None
+        self._n_split = 1 << self.dims
+
+    @classmethod
+    def build(cls, points: np.ndarray) -> "SpTree":
+        pts = np.asarray(points, np.float64)
+        lo, hi = pts.min(0), pts.max(0)
+        center = (lo + hi) / 2.0
+        width = np.maximum((hi - lo) / 2.0 + 1e-9, 1e-9)
+        tree = cls(center, width)
+        for i in range(pts.shape[0]):
+            tree.insert(pts[i], i)
+        return tree
+
+    def _child_for(self, point: np.ndarray) -> int:
+        code = 0
+        for d in range(self.dims):
+            if point[d] > self.center[d]:
+                code |= (1 << d)
+        return code
+
+    def _subdivide(self):
+        self.children = []
+        for code in range(self._n_split):
+            offs = np.array([(1 if code & (1 << d) else -1)
+                             for d in range(self.dims)], np.float64)
+            self.children.append(
+                SpTree(self.center + offs * self.width / 2.0,
+                       self.width / 2.0))
+
+    def insert(self, point: np.ndarray, index: int) -> None:
+        point = np.asarray(point, np.float64)
+        self.cum_center = (self.cum_center * self.cum_size + point) \
+            / (self.cum_size + 1)
+        self.cum_size += 1
+        if self.children is None:
+            if self.point_index is None and self.cum_size == 1:
+                self.point_index = index
+                self._leaf_point = point
+                return
+            if self._leaf_point is not None and np.array_equal(
+                    point, self._leaf_point):
+                return  # exact duplicate: cum stats absorb it (reference
+                #         SpTree duplicate collapse — avoids infinite split)
+            # occupied leaf: split and push both points down
+            old_idx = self.point_index
+            old_pt = self._leaf_point
+            self.point_index = None
+            self._leaf_point = None
+            self._subdivide()
+            if old_idx is not None and old_pt is not None:
+                self.children[self._child_for(old_pt)].insert(old_pt, old_idx)
+        self.children[self._child_for(point)].insert(point, index)
+
+    def depth(self) -> int:
+        if self.children is None:
+            return 1
+        return 1 + max(c.depth() for c in self.children if c.cum_size > 0)
+
+    def compute_non_edge_forces(self, point: np.ndarray, theta: float,
+                                skip_index: Optional[int] = None
+                                ) -> Tuple[np.ndarray, float]:
+        """Barnes-Hut negative-force accumulation for one query point
+        (reference SpTree.computeNonEdgeForces): returns (force [D], sum_Q).
+        A cell is summarized when width/dist < theta."""
+        point = np.asarray(point, np.float64)
+        force = np.zeros(self.dims)
+        sum_q = 0.0
+        stack = [self]
+        while stack:
+            node = stack.pop()
+            if node.cum_size == 0:
+                continue
+            if node.children is None and node.point_index == skip_index:
+                continue
+            diff = point - node.cum_center
+            d2 = float(diff @ diff)
+            max_width = float(node.width.max()) * 2.0
+            is_leaf = node.children is None
+            if is_leaf or max_width * max_width < theta * theta * d2:
+                q = 1.0 / (1.0 + d2)
+                mult = node.cum_size * q
+                sum_q += mult
+                force += mult * q * diff
+            else:
+                stack.extend(c for c in node.children if c.cum_size > 0)
+        return force, sum_q
+
+
+class QuadTree(SpTree):
+    """2-D specialization (reference clustering/quadtree/QuadTree.java)."""
+
+    def __init__(self, center=None, width=None):
+        if center is None:
+            center = np.zeros(2)
+        if width is None:
+            width = np.ones(2)
+        if len(np.asarray(center)) != 2:
+            raise ValueError("QuadTree is 2-D; use SpTree for higher dims")
+        super().__init__(center, width)
+
+    @classmethod
+    def build(cls, points: np.ndarray) -> "QuadTree":
+        pts = np.asarray(points, np.float64)
+        if pts.shape[1] != 2:
+            raise ValueError("QuadTree expects [N, 2] points")
+        return super().build(pts)  # type: ignore[return-value]

@@ -49,6 +49,9 @@ class ComputationGraph:
         self.updater_state: Dict[str, Dict[str, Dict[str, Array]]] = {}
         self.step = 0
         self._score_raw: Any = float("nan")
+        # minibatches fused per device dispatch in fit(iterator) — one
+        # jitted lax.scan over stacked batches (see fit_scan)
+        self.scan_batches = 16
         self.listeners: List[Any] = []
         self._rnn_state: Dict[str, Any] = {}
         self._jit_cache: Dict[Any, Any] = {}
@@ -307,9 +310,114 @@ class ComputationGraph:
         if hasattr(data, "features"):
             self._fit_single_ds(data)
             return self
-        for ds in data:
-            self._fit_single_ds(ds)
+        self._fit_iterator(data)
         return self
+
+    def _can_scan(self) -> bool:
+        algo = (self.conf.conf.optimization_algo or
+                "stochastic_gradient_descent").lower()
+        return (self.scan_batches > 1 and self.conf.conf.iterations <= 1
+                and algo in ("stochastic_gradient_descent", "sgd"))
+
+    def _fit_iterator(self, iterator):
+        """Fuse runs of same-shape unmasked (Multi)DataSets into one
+        device-resident lax.scan dispatch — the DAG analog of
+        MultiLayerNetwork._fit_iterator."""
+        if not self._can_scan():
+            for ds in iterator:
+                self._fit_single_ds(ds)
+            return
+
+        def norm(ds):
+            if hasattr(ds, "features_masks"):
+                return (list(ds.features), list(ds.labels),
+                        ds.features_masks, ds.labels_masks)
+            fm = getattr(ds, "features_mask", None)
+            lm = getattr(ds, "labels_mask", None)
+            return ([ds.features], [ds.labels],
+                    [fm] if fm is not None else None,
+                    [lm] if lm is not None else None)
+
+        buf = []
+        buf_shapes = None
+
+        def flush():
+            nonlocal buf
+            if not buf:
+                return
+            if len(buf) < self.scan_batches:
+                for ins, labs, _, _ in buf:
+                    self._fit_one(ins, labs, None, None)
+            else:
+                xs = [np.stack([np.asarray(t[0][k]) for t in buf])
+                      for k in range(len(buf[0][0]))]
+                ys = [np.stack([np.asarray(t[1][k]) for t in buf])
+                      for k in range(len(buf[0][1]))]
+                self.fit_scan(xs, ys)
+            buf = []
+
+        for ds in iterator:
+            ins, labs, fms, lms = norm(ds)
+            if fms is not None or lms is not None:
+                flush()
+                self._fit_one(ins, labs, fms, lms)
+                continue
+            shapes = (tuple(np.asarray(a).shape for a in ins),
+                      tuple(np.asarray(a).shape for a in labs))
+            if buf and shapes != buf_shapes:
+                flush()
+            buf_shapes = shapes
+            buf.append((ins, labs, fms, lms))
+            if len(buf) >= self.scan_batches:
+                flush()
+        flush()
+
+    def fit_scan(self, xs_list, ys_list):
+        """Run K training steps device-resident: one jitted lax.scan over
+        stacked minibatches. xs_list/ys_list: lists (per network input /
+        output) of [K, B, ...] arrays. Masks are not supported on this path
+        (fit(iterator) routes masked batches through the one-step path)."""
+        self._check_init()
+        if not self._can_scan():
+            raise ValueError("fit_scan requires SGD-class training "
+                             "(iterations=1, scan_batches>1)")
+        xs_list = [jnp.asarray(a) for a in xs_list]
+        ys_list = [jnp.asarray(a) for a in ys_list]
+        cache_key = ("multi", len(xs_list), len(ys_list))
+        if cache_key not in self._jit_cache:
+            base = self._build_train_step()
+
+            def multi(params, variables, ustates, step0, rng, xs, ys):
+                def body(carry, inp):
+                    params, variables, ustates, step = carry
+                    bx, by = inp
+                    sub = jax.random.fold_in(rng, step)
+                    p, v, u, loss = base(params, variables, ustates, step,
+                                         sub, list(bx), list(by), None, None)
+                    return (p, v, u, step + 1), loss
+
+                (params, variables, ustates, _), losses = jax.lax.scan(
+                    body, (params, variables, ustates, step0),
+                    (tuple(xs), tuple(ys)))
+                return params, variables, ustates, losses
+
+            self._jit_cache[cache_key] = jax.jit(multi,
+                                                 donate_argnums=(0, 1, 2))
+        fn = self._jit_cache[cache_key]
+        self._key, sub = jax.random.split(self._key)
+        k = int(xs_list[0].shape[0])
+        (self.params, self.variables, self.updater_state, losses) = fn(
+            self.params, self.variables, self.updater_state,
+            jnp.asarray(self.step), sub, tuple(xs_list), tuple(ys_list))
+        self.step += k
+        self._score_raw = losses[-1]
+        if self.listeners:
+            host_losses = np.asarray(losses)
+            for j in range(k):
+                self._score_raw = float(host_losses[j])
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.step - k + 1 + j)
+        return losses
 
     def _fit_single_ds(self, ds):
         if hasattr(ds, "features_masks"):  # MultiDataSet

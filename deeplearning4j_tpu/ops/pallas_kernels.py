@@ -316,6 +316,16 @@ _AUTOTUNE_CACHE: Dict = {}
 _AUTOTUNE_ITERS = 30
 
 
+def autotune_decisions() -> Dict:
+    """Snapshot of the per-shape kernel-vs-XLA decisions made so far:
+    {(T, B, H, dtype, activation, reverse): kernel_selected}."""
+    return dict(_AUTOTUNE_CACHE)
+
+
+def clear_autotune_cache() -> None:
+    _AUTOTUNE_CACHE.clear()
+
+
 def _autotune_lstm(T, B, H, dtype, activation, reverse) -> bool:
     """Empirical per-shape selection, the TPU analog of
     cudnnFindConvolutionForwardAlgorithm: run both implementations on this
@@ -332,26 +342,50 @@ def _autotune_lstm(T, B, H, dtype, activation, reverse) -> bool:
     h0 = jnp.zeros((B, H), dtype)
     c0 = jnp.zeros((B, H), dtype)
 
-    xla = jax.jit(lambda *a: helpers._lstm_sequence_default(
-        *a, activation=activation, reverse=reverse))
-    pal = jax.jit(lambda *a: _lstm_sequence_forward(
-        *a, activation, reverse))
+    def ref(*a):
+        return helpers._lstm_sequence_default(
+            *a, activation=activation, reverse=reverse)
 
-    def measure(fn):
-        out = fn(xp, rw, peep, h0, c0)
-        _ = float(jnp.sum(out[0]))  # full sync (block_until_ready can lie
+    pal_vjp = _get_lstm_fn(activation, reverse)
+
+    # The decision cost is the TRAINING cost: the kernel's custom_vjp
+    # re-runs the XLA reference in its backward (rematerialization), so a
+    # forward-only win can still lose end-to-end. Gate on fwd+bwd AND
+    # fwd-only — the kernel must win both to be selected.
+    args = (xp, rw, peep, h0, c0)
+
+    def train_like(fn):
+        def loss(a):
+            ys, ht, ct = fn(*a)
+            return jnp.sum(ys.astype(jnp.float32)) + jnp.sum(
+                ht.astype(jnp.float32))
+        g = jax.jit(jax.grad(loss))
+        return lambda: g(args)
+
+    def fwd_only(fn):
+        j = jax.jit(lambda *a: fn(*a)[0])
+        return lambda: j(*args)
+
+    def measure(thunk):
+        out = thunk()
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        _ = float(jnp.sum(leaf))    # full sync (block_until_ready can lie
         t0 = time.perf_counter()    # through the axon tunnel)
         for _i in range(_AUTOTUNE_ITERS):
-            out = fn(xp, rw, peep, h0, c0)
-        _ = float(jnp.sum(out[0]))
+            out = thunk()
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        _ = float(jnp.sum(leaf))
         return time.perf_counter() - t0
 
     try:
-        t_pal = measure(pal)
+        t_pal_f = measure(fwd_only(pal_vjp))
+        t_pal_t = measure(train_like(pal_vjp))
     except Exception:
         return False  # kernel unsupported on this shape/backend
-    t_xla = measure(xla)
-    return t_pal < t_xla * 0.95  # margin against flapping on noise
+    t_xla_f = measure(fwd_only(ref))
+    t_xla_t = measure(train_like(ref))
+    # 0.95 margin against flapping on measurement noise
+    return (t_pal_f < t_xla_f * 0.95) and (t_pal_t < t_xla_t * 0.95)
 
 
 def lstm_sequence_pallas(xproj_t, rw, peep, h0, c0, *, activation, reverse):

@@ -6,6 +6,7 @@ repulsion. `theta` remains a documented no-op (module docstring).
 """
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.plot.tsne import (BarnesHutTsne, Tsne,
                                           _beta_search_rows, _knn_graph)
@@ -76,3 +77,32 @@ def test_dense_and_sparse_agree_on_structure():
         inter = np.mean([np.linalg.norm(cm[i] - cm[j])
                          for i in range(3) for j in range(i + 1, 3)])
         assert inter / intra > 2.5
+
+
+def test_sptree_quadtree_barnes_hut():
+    """Reference clustering/sptree + quadtree: insertion, center-of-mass,
+    and theta-gated force accumulation matching the exact O(N^2) sum."""
+    from deeplearning4j_tpu.clustering.trees import QuadTree, SpTree
+    rng = np.random.default_rng(0)
+    for dims, cls in ((2, QuadTree), (3, SpTree)):
+        pts = rng.normal(size=(300, dims))
+        t = cls.build(pts)
+        assert t.cum_size == 300
+        np.testing.assert_allclose(t.cum_center, pts.mean(0), atol=1e-9)
+        i = 7
+        f_bh, sq_bh = t.compute_non_edge_forces(pts[i], theta=0.3,
+                                                skip_index=i)
+        diff = pts[i] - pts
+        d2 = (diff ** 2).sum(1)
+        q = 1.0 / (1.0 + d2)
+        q[i] = 0.0
+        f_ex = ((q ** 2)[:, None] * diff).sum(0)
+        assert np.linalg.norm(f_bh - f_ex) / np.linalg.norm(f_ex) < 0.05
+        assert abs(sq_bh - q.sum()) / q.sum() < 0.02
+        # theta=0 opens every cell -> exact
+        f0, sq0 = t.compute_non_edge_forces(pts[i], theta=0.0, skip_index=i)
+        np.testing.assert_allclose(f0, f_ex, atol=1e-9)
+    # duplicates collapse instead of infinite-splitting
+    QuadTree.build(np.zeros((10, 2)))
+    with pytest.raises(ValueError):
+        QuadTree.build(np.zeros((4, 3)))
