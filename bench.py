@@ -135,7 +135,7 @@ def main() -> None:
                B, 2, 512, dtype)
 
     # ---- 4. GravesLSTM char-RNN (one TBPTT window), helper on/off delta -----
-    B, T, V = 32, 50, 77
+    B, T, V = 128, 50, 77
     xs = jnp.asarray(rng.normal(size=(B, T, V)), jnp.float32)
     ys = jnp.asarray(np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))])
     _bench_net("char_rnn_lstm", char_rnn_lstm(dtype=dtype), xs, ys,

@@ -10,6 +10,7 @@ Usage:
                    [--runtime local|data-parallel]
   dl4j-tpu test    --model model.zip --input data.csv [--label-index I]
   dl4j-tpu predict --model model.zip --input data.csv [--output preds.csv]
+  dl4j-tpu serve   --model model.zip [--port P]
 """
 from __future__ import annotations
 
@@ -86,6 +87,28 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serve a saved model over HTTP (the dl4j-streaming serve-route
+    analog, serving/server.py)."""
+    import time
+
+    from ..serving import InferenceServer
+
+    server = InferenceServer(model_path=args.model, port=args.port,
+                             max_batch=args.max_batch).start()
+    print(f"Serving {args.model} on http://127.0.0.1:{server.port} "
+          "(POST /predict, /predict/csv; GET /health, /info)")
+    if args.once:  # test hook: start, report, stop
+        server.stop()
+        return 0
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
 def _add_data_args(p: argparse.ArgumentParser):
     p.add_argument("--input", required=True, help="input CSV path")
     p.add_argument("--batch", type=int, default=32)
@@ -122,6 +145,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", default=None)
     _add_data_args(p)
     p.set_defaults(func=cmd_predict)
+
+    s = sub.add_parser("serve", help="serve a saved model over HTTP")
+    s.add_argument("--model", required=True)
+    s.add_argument("--port", type=int, default=0)
+    s.add_argument("--max-batch", type=int, default=1024)
+    s.add_argument("--once", action="store_true",
+                   help="start and immediately stop (smoke test)")
+    s.set_defaults(func=cmd_serve)
     return parser
 
 

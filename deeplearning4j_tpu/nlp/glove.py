@@ -9,7 +9,6 @@ autodiff's gather-transpose replaces the per-pair scatter updates.
 """
 from __future__ import annotations
 
-from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -24,32 +23,124 @@ from .word2vec import MappedBuilder, SequenceVectors
 
 class AbstractCoOccurrences:
     """Windowed symmetric co-occurrence counts with 1/d weighting
-    (reference models/glove/AbstractCoOccurrences)."""
+    (reference models/glove/AbstractCoOccurrences + its disk-spilled
+    counting in models/glove/count/).
 
-    def __init__(self, window: int = 15, symmetric: bool = True):
+    Counting is vectorized (per-offset masks over the concatenated corpus,
+    coalesced with np.unique — no per-token Python loop), and memory is
+    bounded like the reference's CountMap spill: when accumulated unique
+    pairs exceed `max_pairs_in_memory`, the partial COO shard is written to
+    `spill_dir` (or a temp dir) and counting continues with an empty
+    accumulator; `triples()` merges all shards."""
+
+    def __init__(self, window: int = 15, symmetric: bool = True,
+                 max_pairs_in_memory: int = 10_000_000,
+                 spill_dir: Optional[str] = None,
+                 vocab_size: Optional[int] = None):
+        import uuid
         self.window = window
         self.symmetric = symmetric
-        self.counts: Dict[Tuple[int, int], float] = defaultdict(float)
+        self.max_pairs = max_pairs_in_memory
+        self.spill_dir = spill_dir
+        self._keys = np.zeros(0, np.int64)
+        self._vals = np.zeros(0, np.float64)
+        self._shards: List[str] = []
+        self._tmpdir = None
+        self._shard_tag = uuid.uuid4().hex[:12]  # unique within shared dirs
+        # pass vocab_size for incremental fits (Glove supplies it); without
+        # it the key base grows by re-basing stored keys when needed
+        self._n = int(vocab_size) if vocab_size else 0
+
+    def _coalesce(self, keys: np.ndarray, vals: np.ndarray):
+        uk, inv = np.unique(keys, return_inverse=True)
+        sums = np.bincount(inv, weights=vals, minlength=uk.size)
+        return uk, sums
+
+    def _spill(self):
+        import shutil
+        import tempfile
+        import weakref
+        if self.spill_dir is None and self._tmpdir is None:
+            self._tmpdir = tempfile.mkdtemp(prefix="glove_cooc_")
+            weakref.finalize(self, shutil.rmtree, self._tmpdir,
+                             ignore_errors=True)
+        d = self.spill_dir or self._tmpdir
+        path = f"{d}/shard_{self._shard_tag}_{len(self._shards):04d}.npz"
+        np.savez_compressed(path, keys=self._keys, vals=self._vals)
+        self._shards.append(path)
+        self._keys = np.zeros(0, np.int64)
+        self._vals = np.zeros(0, np.float64)
+
+    def _rebase(self, new_v: int):
+        """Re-encode stored keys from base self._n to base new_v (vocab
+        grew across incremental fits without an up-front vocab_size)."""
+        old_v = self._n
+
+        def rebase(keys):
+            return (keys // old_v) * new_v + (keys % old_v)
+
+        self._keys = rebase(self._keys)
+        for path in self._shards:
+            with np.load(path) as z:
+                k, v = rebase(z["keys"]), z["vals"]
+            np.savez_compressed(path, keys=k, vals=v)
+        self._n = new_v
+
+    def _absorb(self, keys: np.ndarray, vals: np.ndarray):
+        """Merge a pair chunk into the bounded in-memory accumulator,
+        spilling when it exceeds max_pairs (memory stays bounded even
+        within one large fit() call)."""
+        self._keys, self._vals = self._coalesce(
+            np.concatenate([self._keys, keys]),
+            np.concatenate([self._vals, vals]))
+        if self._keys.size > self.max_pairs:
+            self._spill()
 
     def fit(self, encoded_sequences: List[np.ndarray]):
         w = self.window
-        for seq in encoded_sequences:
-            n = len(seq)
-            for i in range(n):
-                for j in range(max(0, i - w), i):
-                    weight = 1.0 / (i - j)
-                    a, b = int(seq[i]), int(seq[j])
-                    self.counts[(a, b)] += weight
-                    if self.symmetric:
-                        self.counts[(b, a)] += weight
+        seqs = [np.asarray(s, np.int64) for s in encoded_sequences
+                if len(s) >= 2]
+        if not seqs:
+            return self
+        toks = np.concatenate(seqs)
+        needed = int(toks.max()) + 1
+        if self._n == 0:
+            self._n = needed
+        elif needed > self._n:
+            self._rebase(needed)
+        V = self._n
+        lens = np.array([len(s) for s in seqs])
+        seq_id = np.repeat(np.arange(len(seqs)), lens)
+        for d in range(1, w + 1):
+            if d >= toks.size:
+                break
+            same = seq_id[:-d] == seq_id[d:]
+            a = toks[d:][same]     # later token
+            b = toks[:-d][same]    # earlier token, distance d
+            wgt = np.full(a.size, 1.0 / d)
+            if self.symmetric:
+                self._absorb(np.concatenate([a * V + b, b * V + a]),
+                             np.concatenate([wgt, wgt]))
+            else:
+                self._absorb(a * V + b, wgt)
         return self
 
     def triples(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        items = list(self.counts.items())
-        rows = np.array([k[0] for k, _ in items], np.int32)
-        cols = np.array([k[1] for k, _ in items], np.int32)
-        vals = np.array([v for _, v in items], np.float32)
-        return rows, cols, vals
+        keys, vals = self._keys, self._vals
+        for path in self._shards:
+            with np.load(path) as z:
+                keys = np.concatenate([keys, z["keys"]])
+                vals = np.concatenate([vals, z["vals"]])
+        keys, vals = self._coalesce(keys, vals)
+        V = max(self._n, 1)
+        return ((keys // V).astype(np.int32), (keys % V).astype(np.int32),
+                vals.astype(np.float32))
+
+    @property
+    def counts(self) -> Dict[Tuple[int, int], float]:
+        """Dict view for small corpora (compat with the prior API)."""
+        r, c, v = self.triples()
+        return {(int(a), int(b)): float(x) for a, b, x in zip(r, c, v)}
 
 
 class Glove(SequenceVectors):
@@ -87,7 +178,9 @@ class Glove(SequenceVectors):
     def fit_sequences(self, sequences: List[List[str]]):
         self.vocab = VocabConstructor(self.min_word_frequency).build_vocab(sequences)
         encoded = self._encode(sequences)
-        cooc = AbstractCoOccurrences(self.window, self.symmetric).fit(encoded)
+        cooc = AbstractCoOccurrences(
+            self.window, self.symmetric,
+            vocab_size=self.vocab.num_words()).fit(encoded)
         rows, cols, vals = cooc.triples()
         V, D = self.vocab.num_words(), self.layer_size
         rng = np.random.default_rng(self.seed)

@@ -105,3 +105,32 @@ def phase_timer(stats: Optional[SparkTrainingStats], name: str):
         yield
     finally:
         stats.add_event(name, start, (time.perf_counter() - t0) * 1000.0)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str, host_stats: Optional[SparkTrainingStats] = None,
+                 phase: str = "device_trace"):
+    """Capture a device-level profiler trace around a training region —
+    the TPU analog of the reference's per-phase Spark instrumentation
+    (SURVEY §5: "jax profiler traces + per-phase host metrics; keep the
+    stats SPI"). Writes a TensorBoard/XPlane trace under `log_dir`
+    (inspect with tensorboard or xprof) while also recording the wall time
+    as a phase event in the host-side stats, so one context manager gives
+    both views. Degrades to host timing only if the profiler is
+    unavailable on the backend."""
+    import jax
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        pass
+    try:
+        with phase_timer(host_stats, phase):
+            yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

@@ -1,0 +1,5 @@
+from .tpu_pods import (ClusterSetup, GcsTransfer, TpuPodProvisioner,
+                       ProvisionError)
+
+__all__ = ["ClusterSetup", "GcsTransfer", "TpuPodProvisioner",
+           "ProvisionError"]

@@ -1,0 +1,162 @@
+"""Cluster provisioning: TPU pod slices + object-store data movement.
+
+Capability parity with `deeplearning4j-aws` (SURVEY.md §2.4):
+  - `Ec2BoxCreator` (launch a fleet of boxes)      -> TpuPodProvisioner
+  - `ClusterSetup` / `HostProvisioner` (ssh setup) -> ClusterSetup (per-host
+    command execution over the TPU VM's ssh channel)
+  - `S3Downloader` / `S3Uploader`                  -> GcsTransfer
+
+The substrate differs by design: TPU capacity is provisioned as named pod
+slices through the cloud CLI rather than by enumerating EC2 instances, and
+object storage is GCS. Every operation builds an explicit command line; in
+`dry_run` mode (the default) commands are RECORDED, not executed, which is
+what the tests assert — this module must be operable in a zero-egress
+environment and auditable before it touches a real project.
+"""
+from __future__ import annotations
+
+import subprocess
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+
+class ProvisionError(RuntimeError):
+    pass
+
+
+@dataclass
+class CommandRunner:
+    """Executes (or records) command lines. Injectable for tests/CI."""
+
+    dry_run: bool = True
+    recorded: List[List[str]] = field(default_factory=list)
+
+    def run(self, cmd: Sequence[str], timeout: float = 600.0) -> str:
+        cmd = list(cmd)
+        self.recorded.append(cmd)
+        if self.dry_run:
+            return ""
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise ProvisionError(f"command failed to execute: {cmd}: {e}")
+        if proc.returncode != 0:
+            raise ProvisionError(
+                f"command failed rc={proc.returncode}: {cmd}\n{proc.stderr}")
+        return proc.stdout
+
+
+@dataclass
+class TpuPodProvisioner:
+    """Create/list/delete TPU pod slices (reference Ec2BoxCreator.create()).
+
+    Builds `gcloud compute tpus tpu-vm` command lines; the accelerator
+    topology replaces the reference's instance-count knob (a v5e-8 slice is
+    'the 8-box cluster')."""
+
+    project: str
+    zone: str
+    accelerator_type: str = "v5litepod-8"
+    runtime_version: str = "v2-alpha-tpuv5-lite"
+    runner: CommandRunner = field(default_factory=CommandRunner)
+
+    def _base(self) -> List[str]:
+        return ["gcloud", "compute", "tpus", "tpu-vm"]
+
+    def create(self, name: str, preemptible: bool = False,
+               labels: Optional[Dict[str, str]] = None) -> List[str]:
+        cmd = self._base() + [
+            "create", name,
+            f"--project={self.project}", f"--zone={self.zone}",
+            f"--accelerator-type={self.accelerator_type}",
+            f"--version={self.runtime_version}"]
+        if preemptible:
+            cmd.append("--preemptible")
+        if labels:
+            cmd.append("--labels=" + ",".join(
+                f"{k}={v}" for k, v in sorted(labels.items())))
+        self.runner.run(cmd, timeout=1800)
+        return cmd
+
+    def delete(self, name: str) -> List[str]:
+        cmd = self._base() + ["delete", name, f"--project={self.project}",
+                              f"--zone={self.zone}", "--quiet"]
+        self.runner.run(cmd)
+        return cmd
+
+    def list_nodes(self) -> List[str]:
+        cmd = self._base() + ["list", f"--project={self.project}",
+                              f"--zone={self.zone}", "--format=value(name)"]
+        out = self.runner.run(cmd)
+        return [l for l in out.splitlines() if l.strip()]
+
+    def describe(self, name: str) -> List[str]:
+        cmd = self._base() + ["describe", name, f"--project={self.project}",
+                              f"--zone={self.zone}"]
+        self.runner.run(cmd)
+        return cmd
+
+
+@dataclass
+class ClusterSetup:
+    """Run setup commands on every host of a slice (reference
+    ClusterSetup/HostProvisioner: ssh provisioning of the fleet)."""
+
+    provisioner: TpuPodProvisioner
+    name: str
+
+    def run_on_all(self, command: str) -> List[str]:
+        cmd = self.provisioner._base() + [
+            "ssh", self.name,
+            f"--project={self.provisioner.project}",
+            f"--zone={self.provisioner.zone}",
+            "--worker=all", f"--command={command}"]
+        self.provisioner.runner.run(cmd, timeout=1800)
+        return cmd
+
+    def copy_to_all(self, local_path: str, remote_path: str) -> List[str]:
+        cmd = self.provisioner._base() + [
+            "scp", local_path, f"{self.name}:{remote_path}",
+            f"--project={self.provisioner.project}",
+            f"--zone={self.provisioner.zone}", "--worker=all"]
+        self.provisioner.runner.run(cmd, timeout=1800)
+        return cmd
+
+    def bootstrap(self, wheel_or_repo: str,
+                  extra_commands: Sequence[str] = ()) -> None:
+        """The reference's full provision pass: ship the artifact, install,
+        then run any extra setup commands on every worker."""
+        self.copy_to_all(wheel_or_repo, "~/dl4j_tpu_artifact")
+        self.run_on_all("pip install ~/dl4j_tpu_artifact")
+        for c in extra_commands:
+            self.run_on_all(c)
+
+
+@dataclass
+class GcsTransfer:
+    """Bulk data movement (reference S3Downloader/S3Uploader)."""
+
+    runner: CommandRunner = field(default_factory=CommandRunner)
+
+    def upload(self, local_path: str, gcs_uri: str,
+               recursive: bool = True) -> List[str]:
+        if not gcs_uri.startswith("gs://"):
+            raise ProvisionError(f"not a GCS uri: {gcs_uri}")
+        cmd = ["gcloud", "storage", "cp"]
+        if recursive:
+            cmd.append("--recursive")
+        cmd += [local_path, gcs_uri]
+        self.runner.run(cmd, timeout=3600)
+        return cmd
+
+    def download(self, gcs_uri: str, local_path: str,
+                 recursive: bool = True) -> List[str]:
+        if not gcs_uri.startswith("gs://"):
+            raise ProvisionError(f"not a GCS uri: {gcs_uri}")
+        cmd = ["gcloud", "storage", "cp"]
+        if recursive:
+            cmd.append("--recursive")
+        cmd += [gcs_uri, local_path]
+        self.runner.run(cmd, timeout=3600)
+        return cmd

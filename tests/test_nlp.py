@@ -222,3 +222,57 @@ def test_paragraph_vectors_dm():
           .build())
     pv.fit()
     assert pv.nearest_labels("cat dog pet", 1) == ["animal"]
+
+
+def test_cooccurrence_vectorized_and_spilled():
+    """AbstractCoOccurrences: the vectorized counter must equal the
+    per-token reference loop (1/d weighting, symmetric), and disk-spilled
+    shards (reference models/glove/count/) must merge to the same counts."""
+    from deeplearning4j_tpu.nlp.glove import AbstractCoOccurrences
+    rng = np.random.default_rng(0)
+    seqs = [rng.integers(0, 20, rng.integers(2, 30)).astype(np.int64)
+            for _ in range(40)]
+    ref = {}
+    W = 5
+    for seq in seqs:
+        for i in range(len(seq)):
+            for j in range(max(0, i - W), i):
+                wgt = 1.0 / (i - j)
+                a, b = int(seq[i]), int(seq[j])
+                ref[(a, b)] = ref.get((a, b), 0.0) + wgt
+                ref[(b, a)] = ref.get((b, a), 0.0) + wgt
+    got = AbstractCoOccurrences(window=W).fit(seqs).counts
+    assert set(got) == set(ref)
+    for k in ref:  # counts accumulate in f64, emit in f32
+        assert abs(got[k] - ref[k]) <= 1e-6 * max(1.0, abs(ref[k]))
+
+    spilled = AbstractCoOccurrences(window=W, max_pairs_in_memory=50)
+    spilled.fit(seqs[:20])
+    spilled.fit(seqs[20:])
+    assert spilled._shards  # actually spilled to disk
+    got2 = spilled.counts
+    for k in ref:
+        assert abs(got2[k] - ref[k]) <= 1e-6 * max(1.0, abs(ref[k]))
+
+
+def test_cooccurrence_incremental_vocab_growth(tmp_path):
+    """Incremental fits may introduce new token ids; stored keys re-base
+    (or pass vocab_size up front). Shared spill dirs must not collide."""
+    from deeplearning4j_tpu.nlp.glove import AbstractCoOccurrences
+    a = AbstractCoOccurrences(window=2)
+    a.fit([np.array([0, 1, 0])])
+    a.fit([np.array([0, 5, 0])])  # vocab grew: keys re-based, no error
+    got = a.counts
+    assert got[(0, 1)] > 0 and got[(0, 5)] > 0
+
+    # two counters sharing one spill dir keep distinct shards
+    d = str(tmp_path)
+    c1 = AbstractCoOccurrences(window=2, max_pairs_in_memory=1, spill_dir=d,
+                               vocab_size=10)
+    c2 = AbstractCoOccurrences(window=2, max_pairs_in_memory=1, spill_dir=d,
+                               vocab_size=10)
+    c1.fit([np.array([0, 1, 2, 3])])
+    c2.fit([np.array([4, 5, 6, 7])])
+    k1 = set(c1.counts)
+    k2 = set(c2.counts)
+    assert k1 and k2 and not (k1 & k2)  # no shard cross-talk

@@ -149,3 +149,57 @@ def test_pa_master_trains_on_all_data_with_remainder():
     master.execute_training(net, ListDataSetIterator(ds, 48))
     # 48 examples = 1 full round + remainder round -> 2*freq steps
     assert net.step == 2 * freq
+
+
+def test_spark_api_facades():
+    """Driver-facing wrappers (reference SparkDl4jMultiLayer.java:67 /
+    SparkComputationGraph.java): fit(RDD-like) through a master,
+    sharded evaluate/score, fit_paths from serialized DataSets."""
+    from deeplearning4j_tpu.parallel.spark_api import (SparkComputationGraph,
+                                                       SparkDl4jMultiLayer)
+    from deeplearning4j_tpu.models.zoo import mlp_iris
+    from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+    import tempfile, os
+
+    iris = load_iris_dataset()
+    rdd = [DataSet(iris.features[i:i + 30], iris.labels[i:i + 30])
+           for i in range(0, 150, 30)]
+
+    s = SparkDl4jMultiLayer(mlp_iris())
+    for _ in range(30):
+        s.fit(rdd)
+    ev = s.evaluate(rdd)
+    assert ev.accuracy() > 0.9
+    assert np.isfinite(s.score(rdd))
+    preds = s.predict(iris.features[:10])
+    assert preds.shape == (10, 3)
+    assert s.get_network().step == 30 * 5
+
+    # fit from serialized dataset paths (pre-vectorized export workflow)
+    td = tempfile.mkdtemp()
+    paths = []
+    for i, ds in enumerate(rdd):
+        p = os.path.join(td, f"ds{i}.npz")
+        np.savez(p, features=ds.features, labels=ds.labels)
+        paths.append(p)
+    s2 = SparkDl4jMultiLayer(mlp_iris())
+    s2.fit_paths(paths)
+    assert s2.get_network().step == 5
+
+    # graph facade with the parameter-averaging master
+    from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    gconf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.1)
+             .graph_builder().add_inputs("in")
+             .add_layer("h", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                        "in")
+             .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                           activation="softmax",
+                                           loss="negativeloglikelihood"), "h")
+             .set_outputs("out").build())
+    master = ParameterAveragingTrainingMaster(batch_size_per_worker=8,
+                                              averaging_frequency=1)
+    sg = SparkComputationGraph(gconf, training_master=master)
+    sg.fit(rdd)
+    assert np.isfinite(sg.get_network().score_)
+    assert sg.predict(iris.features[:4]).shape == (4, 3)

@@ -1,0 +1,60 @@
+"""Provisioning module (AWS-analog) + profiler hook in the stats SPI."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.provision import (ClusterSetup, GcsTransfer,
+                                          ProvisionError, TpuPodProvisioner)
+from deeplearning4j_tpu.provision.tpu_pods import CommandRunner
+from deeplearning4j_tpu.parallel.stats import (SparkTrainingStats,
+                                               device_trace)
+
+
+def test_provisioner_builds_commands_dry_run():
+    prov = TpuPodProvisioner(project="proj", zone="us-central2-b",
+                             accelerator_type="v5litepod-8")
+    cmd = prov.create("slice-a", preemptible=True, labels={"team": "ml"})
+    assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "--accelerator-type=v5litepod-8" in cmd
+    assert "--preemptible" in cmd and "--labels=team=ml" in cmd
+    prov.delete("slice-a")
+    prov.describe("slice-a")
+    assert prov.list_nodes() == []  # dry run records, returns nothing
+    assert len(prov.runner.recorded) == 4
+    # nothing was actually executed
+    assert all(c[0] == "gcloud" for c in prov.runner.recorded)
+
+
+def test_cluster_setup_bootstrap():
+    prov = TpuPodProvisioner(project="p", zone="z")
+    setup = ClusterSetup(prov, "slice-a")
+    setup.bootstrap("/tmp/pkg.whl", extra_commands=["echo ok"])
+    cmds = prov.runner.recorded
+    assert any("scp" in c for c in cmds)
+    assert any("--worker=all" in c for c in cmds)
+    assert any(any("pip install" in part for part in c) for c in cmds)
+
+
+def test_gcs_transfer_validation():
+    t = GcsTransfer()
+    up = t.upload("/data", "gs://bucket/data")
+    assert up[:3] == ["gcloud", "storage", "cp"]
+    with pytest.raises(ProvisionError):
+        t.upload("/data", "s3://wrong/store")
+    with pytest.raises(ProvisionError):
+        t.download("http://x", "/data")
+
+
+def test_device_trace_wraps_training(tmp_path):
+    from deeplearning4j_tpu.models.zoo import mlp_iris
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+
+    stats = SparkTrainingStats()
+    net = MultiLayerNetwork(mlp_iris()).init()
+    iris = load_iris_dataset()
+    with device_trace(str(tmp_path / "trace"), stats, phase="fit_region"):
+        net.fit_batch(iris.features, iris.labels)
+    assert stats.count("fit_region") == 1
+    assert stats.total_millis("fit_region") > 0

@@ -159,3 +159,11 @@ def test_ui_tsne_and_nearest_neighbor_views():
             assert "error" in json.loads(r.read())
     finally:
         server.stop()
+
+
+def test_cli_serve_smoke(tmp_path):
+    from deeplearning4j_tpu.util.model_serializer import write_model
+    net = _net()
+    path = tmp_path / "m.zip"
+    write_model(net, path)
+    assert cli_main(["serve", "--model", str(path), "--once"]) == 0
