@@ -176,7 +176,7 @@ def main() -> None:
     # ---- 5. Word2Vec skip-gram words/sec (synthetic zipf corpus; text8 is
     # unfetchable here — zero egress) -----------------------------------------
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
-    V, n_tokens = 5000, 120_000
+    V, n_tokens = 5000, 600_000
     zipf = 1.0 / np.arange(1, V + 1)
     zipf /= zipf.sum()
     tokens = rng.choice(V, size=n_tokens, p=zipf)

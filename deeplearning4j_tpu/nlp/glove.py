@@ -21,6 +21,15 @@ from .vocab import VocabCache, VocabConstructor
 from .word2vec import MappedBuilder, SequenceVectors
 
 
+def _cleanup_shards(paths: List[str]) -> None:
+    import os
+    for p in paths:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
 class AbstractCoOccurrences:
     """Windowed symmetric co-occurrence counts with 1/d weighting
     (reference models/glove/AbstractCoOccurrences + its disk-spilled
@@ -42,9 +51,13 @@ class AbstractCoOccurrences:
         self.symmetric = symmetric
         self.max_pairs = max_pairs_in_memory
         self.spill_dir = spill_dir
+        import weakref
         self._keys = np.zeros(0, np.int64)
         self._vals = np.zeros(0, np.float64)
         self._shards: List[str] = []
+        # GC'd counters remove their own shards even in a shared spill_dir
+        # (the finalizer sees late appends through the shared list object)
+        weakref.finalize(self, _cleanup_shards, self._shards)
         self._tmpdir = None
         self._shard_tag = uuid.uuid4().hex[:12]  # unique within shared dirs
         # pass vocab_size for incremental fits (Glove supplies it); without
@@ -126,21 +139,40 @@ class AbstractCoOccurrences:
         return self
 
     def triples(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        keys, vals = self._keys, self._vals
+        key_parts, val_parts = [self._keys], [self._vals]
         for path in self._shards:
             with np.load(path) as z:
-                keys = np.concatenate([keys, z["keys"]])
-                vals = np.concatenate([vals, z["vals"]])
-        keys, vals = self._coalesce(keys, vals)
+                key_parts.append(z["keys"])
+                val_parts.append(z["vals"])
+        keys, vals = self._coalesce(np.concatenate(key_parts),
+                                    np.concatenate(val_parts))
         V = max(self._n, 1)
         return ((keys // V).astype(np.int32), (keys % V).astype(np.int32),
                 vals.astype(np.float32))
 
+    def close(self) -> None:
+        """Delete this counter's spill shards (also runs via finalizer for
+        the self-created temp dir; call explicitly when using a shared
+        spill_dir so shards don't accumulate across runs)."""
+        import os
+        for path in self._shards:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._shards = []
+
     @property
     def counts(self) -> Dict[Tuple[int, int], float]:
-        """Dict view for small corpora (compat with the prior API)."""
+        """READ-ONLY snapshot as {(row, col): weight}; missing pairs read
+        as 0.0. Mutations are not written back — use fit() to add counts
+        (the pre-round-3 mutable-defaultdict API is retired)."""
+        from collections import defaultdict
         r, c, v = self.triples()
-        return {(int(a), int(b)): float(x) for a, b, x in zip(r, c, v)}
+        out: Dict[Tuple[int, int], float] = defaultdict(float)
+        out.update({(int(a), int(b)): float(x)
+                    for a, b, x in zip(r, c, v)})
+        return out
 
 
 class Glove(SequenceVectors):

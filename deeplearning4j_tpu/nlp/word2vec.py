@@ -20,6 +20,7 @@ tests (like the reference's Word2VecTests), not bitwise comparison.
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -35,6 +36,22 @@ Array = jax.Array
 
 def _log_sigmoid(x):
     return -jax.nn.softplus(-x)
+
+
+def _neg_sampling_loss(syn0, syn1neg, center, context, negs, valid):
+    """Skip-gram negative-sampling loss for one batch (shared by the
+    per-batch and the lax.scan multi-batch step builders — one definition
+    so the collision mask / reduction cannot drift between paths)."""
+    h = syn0[center]                      # [B, D]
+    pos = jnp.sum(h * syn1neg[context], -1)
+    neg = jnp.einsum("bd,bkd->bk", h, syn1neg[negs])
+    # drop sampled negatives that collide with the positive target
+    # (the reference's sampler skips target==negative draws)
+    neg_mask = (negs != context[:, None]).astype(neg.dtype)
+    l = -_log_sigmoid(pos) - jnp.sum(_log_sigmoid(-neg) * neg_mask, -1)
+    # SUM over the batch: to first order this matches the reference's
+    # sequential per-pair SGD total displacement (HogWild semantics)
+    return jnp.sum(l * valid)
 
 
 class InMemoryLookupTable:
@@ -161,28 +178,18 @@ class SequenceVectors:
         instead of rng.choice's O(V) with an explicit prob vector."""
         return self._neg_table[rng.integers(0, self._neg_table.size, shape)]
 
+    #: batches fused per device dispatch on the scan path (also sizes the
+    #: warmup program — keep in sync by construction)
+    SCAN_BATCHES = 64
+
     # -- jitted steps ----------------------------------------------------------
     def _make_neg_step(self):
-        K = self.negative
-
-        def loss_fn(syn0, syn1neg, center, context, negs, valid):
-            h = syn0[center]                      # [B, D]
-            pos = jnp.sum(h * syn1neg[context], -1)
-            neg = jnp.einsum("bd,bkd->bk", h, syn1neg[negs])
-            # drop sampled negatives that collide with the positive target
-            # (the reference's sampler skips target==negative draws)
-            neg_mask = (negs != context[:, None]).astype(neg.dtype)
-            l = -_log_sigmoid(pos) - jnp.sum(_log_sigmoid(-neg) * neg_mask, -1)
-            # SUM over the batch: to first order this matches the reference's
-            # sequential per-pair SGD total displacement (HogWild semantics);
-            # a mean-reduced loss would shrink the update by the batch size
-            return jnp.sum(l * valid)
-
         clip = self.grad_clip
 
         @jax.jit
         def step(syn0, syn1neg, center, context, negs, valid, lr):
-            loss, (g0, g1) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            loss, (g0, g1) = jax.value_and_grad(
+                _neg_sampling_loss, argnums=(0, 1))(
                 syn0, syn1neg, center, context, negs, valid)
             g0 = jnp.clip(g0, -clip, clip)
             g1 = jnp.clip(g1, -clip, clip)
@@ -190,6 +197,32 @@ class SequenceVectors:
                     loss / jnp.maximum(jnp.sum(valid), 1.0))
 
         return step
+
+    def _make_neg_scan_step(self):
+        """K skip-gram/negative batches per device dispatch via lax.scan —
+        the per-batch host->device transfers (6 small arrays each) dominate
+        wall time on a tunnel-attached chip, so the epoch's pair stream is
+        uploaded in large stacked chunks and stepped device-resident (the
+        same design as MultiLayerNetwork.fit_scan)."""
+        clip = self.grad_clip
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def scan_step(syn0, syn1neg, centers, contexts, negss, valids, lrs):
+            def body(carry, inp):
+                s0, s1 = carry
+                c, t, n, v, lr = inp
+                loss, (g0, g1) = jax.value_and_grad(
+                    _neg_sampling_loss, argnums=(0, 1))(s0, s1, c, t, n, v)
+                g0 = jnp.clip(g0, -clip, clip)
+                g1 = jnp.clip(g1, -clip, clip)
+                return (s0 - lr * g0, s1 - lr * g1), \
+                    loss / jnp.maximum(jnp.sum(v), 1.0)
+
+            (syn0, syn1neg), losses = jax.lax.scan(
+                body, (syn0, syn1neg), (centers, contexts, negss, valids, lrs))
+            return syn0, syn1neg, losses
+
+        return scan_step
 
     def _make_hs_step(self):
         def loss_fn(syn0, syn1, center, points, codes, code_mask, valid):
@@ -369,6 +402,21 @@ class SequenceVectors:
             step_neg(table.syn0, table.syn1neg, put_b(zi), put_b(zi),
                      put_b(jnp.zeros((B, self.negative), jnp.int32)),
                      put_b(zv), lr0)
+            if (not self.use_hs and self.mesh is None
+                    and total_pairs // max(self.epochs, 1)
+                    >= self.SCAN_BATCHES * B):
+                # warm the multi-batch scan program too (only when an epoch
+                # can actually reach it); zero-valid batches make it a
+                # no-op update (outputs reassigned: it donates)
+                if not hasattr(self, "_scan_step"):
+                    self._scan_step = self._make_neg_scan_step()
+                sn = self.SCAN_BATCHES
+                zc = jnp.zeros((sn, B), jnp.int32)
+                zn = jnp.zeros((sn, B, self.negative), jnp.int32)
+                zvv = jnp.zeros((sn, B), jnp.float32)
+                zl = jnp.zeros((sn,), jnp.float32)
+                table.syn0, table.syn1neg, _ = self._scan_step(
+                    table.syn0, table.syn1neg, zc, zc, zn, zvv, zl)
         if step_hs is not None and not self.cbow:
             Pmax = max(self._max_code_len, 1)
             zp = jnp.zeros((B, Pmax), jnp.int32)
@@ -431,7 +479,39 @@ class SequenceVectors:
                 continue
             perm = rng.permutation(centers.size)
             centers, contexts = centers[perm], contexts[perm]
-            for off in range(0, centers.size, B):
+            # device-resident multi-batch path: full chunks of SCAN batches
+            # go through ONE lax.scan dispatch each (negative-sampling-only,
+            # single device — the mesh path keeps per-batch psum steps)
+            off0 = 0
+            scan_n = self.SCAN_BATCHES
+            if (self.negative > 0 and not self.use_hs and self.mesh is None
+                    and centers.size >= scan_n * B):
+                if not hasattr(self, "_scan_step"):
+                    self._scan_step = self._make_neg_scan_step()
+                chunk_pairs = scan_n * B
+                n_chunks = centers.size // chunk_pairs
+                for ci in range(n_chunks):
+                    lo = ci * chunk_pairs
+                    cs = centers[lo:lo + chunk_pairs].reshape(scan_n, B)
+                    ts = contexts[lo:lo + chunk_pairs].reshape(scan_n, B)
+                    ns = self._sample_negatives(rng,
+                                                (scan_n, B, self.negative))
+                    # per-batch linear lr decay inside the chunk
+                    seen_at = seen + np.arange(scan_n, dtype=np.float64) * B
+                    lrs = np.maximum(
+                        self.min_learning_rate,
+                        self.learning_rate
+                        * (1.0 - np.minimum(1.0, seen_at / total_pairs))
+                    ).astype(np.float32)
+                    valids = np.ones((scan_n, B), np.float32)
+                    table.syn0, table.syn1neg, losses = self._scan_step(
+                        table.syn0, table.syn1neg, jnp.asarray(cs),
+                        jnp.asarray(ts), jnp.asarray(ns),
+                        jnp.asarray(valids), jnp.asarray(lrs))
+                    last_loss = losses[-1]
+                    seen += chunk_pairs
+                off0 = n_chunks * chunk_pairs
+            for off in range(off0, centers.size, B):
                 c = centers[off:off + B]
                 t = contexts[off:off + B]
                 nvalid = c.size

@@ -116,10 +116,13 @@ class ClusterSetup:
         return cmd
 
     def copy_to_all(self, local_path: str, remote_path: str) -> List[str]:
-        cmd = self.provisioner._base() + [
-            "scp", local_path, f"{self.name}:{remote_path}",
-            f"--project={self.provisioner.project}",
-            f"--zone={self.provisioner.zone}", "--worker=all"]
+        import os
+        cmd = self.provisioner._base() + ["scp"]
+        if os.path.isdir(local_path):
+            cmd.append("--recurse")  # gcloud scp rejects dirs without it
+        cmd += [local_path, f"{self.name}:{remote_path}",
+                f"--project={self.provisioner.project}",
+                f"--zone={self.provisioner.zone}", "--worker=all"]
         self.provisioner.runner.run(cmd, timeout=1800)
         return cmd
 

@@ -276,3 +276,17 @@ def test_cooccurrence_incremental_vocab_growth(tmp_path):
     k1 = set(c1.counts)
     k2 = set(c2.counts)
     assert k1 and k2 and not (k1 & k2)  # no shard cross-talk
+
+
+def test_word2vec_scan_path_quality():
+    """The multi-batch lax.scan path (engaged when an epoch has >= 64*batch
+    pairs) must learn the same structure as the per-batch path."""
+    w2v = (Word2Vec.builder()
+           .layer_size(32).window_size(3).min_word_frequency(2)
+           .negative_sample(5).epochs(6).learning_rate(0.05)
+           .seed(42).batch_size(32).iterate(_corpus(400))
+           .build())
+    w2v.fit()
+    assert hasattr(w2v, "_scan_step")  # the scan path actually ran
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "truck")
+    assert w2v.similarity("car", "truck") > w2v.similarity("car", "paw")
