@@ -28,6 +28,7 @@ from .conf.graph import (ComputationGraphConfiguration,
 from .conf.layers import OutputLayer, RnnOutputLayer, LossLayer
 from .layers.base import LayerImpl, impl_for
 from .layers.recurrent import BaseRecurrentImpl
+from .conf.config import BACKPROP_TBPTT
 from .multilayer import _dtype_of
 from .updater.gradnorm import apply_gradient_normalization
 from .updater.schedules import effective_lr
@@ -291,6 +292,30 @@ class ComputationGraph:
 
         return train_step
 
+    def _build_train_step_stateful(self):
+        """Train step that carries RNN vertex states across calls — the
+        TBPTT window step (reference ComputationGraph.backprop(tbptt=true)
+        :960 + rnnUpdateStateWithTBPTTState)."""
+
+        def loss_fn(params, variables, inputs, labels, fmasks, lmasks, rng,
+                    states):
+            acts, new_vars, new_states = self._forward_impl(
+                params, variables, inputs, train=True, rng=rng,
+                fmasks=fmasks, states=states)
+            loss = self._loss(acts, labels, lmasks) + self._reg_loss(params)
+            return loss, (new_vars, new_states)
+
+        def train_step(params, variables, ustates, step, rng, inputs, labels,
+                       fmasks, lmasks, states):
+            ((loss, (new_vars, new_states)), grads) = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, variables, inputs, labels,
+                                       fmasks, lmasks, rng, states)
+            new_params, new_ustates = self._apply_updaters(params, grads,
+                                                           ustates, step)
+            return new_params, new_vars, new_ustates, loss, new_states
+
+        return train_step
+
     def _get_train_step(self, key):
         if key in self._jit_cache:
             return self._jit_cache[key]
@@ -323,7 +348,8 @@ class ComputationGraph:
         """Fuse runs of same-shape unmasked (Multi)DataSets into one
         device-resident lax.scan dispatch — the DAG analog of
         MultiLayerNetwork._fit_iterator."""
-        if not self._can_scan():
+        if (not self._can_scan()
+                or self.conf.backprop_type == BACKPROP_TBPTT):
             for ds in iterator:
                 self._fit_single_ds(ds)
             return
@@ -381,6 +407,9 @@ class ComputationGraph:
         if not self._can_scan():
             raise ValueError("fit_scan requires SGD-class training "
                              "(iterations=1, scan_batches>1)")
+        if self.conf.backprop_type == BACKPROP_TBPTT:
+            raise ValueError("fit_scan does not window TBPTT sequences; "
+                             "use fit() for truncated-BPTT graphs")
         xs_list = [jnp.asarray(a) for a in xs_list]
         ys_list = [jnp.asarray(a) for a in ys_list]
         cache_key = ("multi", len(xs_list), len(ys_list))
@@ -439,6 +468,13 @@ class ComputationGraph:
                     if lmasks else None)
         algo = (self.conf.conf.optimization_algo or
                 "stochastic_gradient_descent").lower()
+        if (self.conf.backprop_type == BACKPROP_TBPTT
+                and any(a.ndim == 3 for a in inputs)):
+            if algo not in ("stochastic_gradient_descent", "sgd"):
+                raise NotImplementedError(
+                    f"optimization_algo={algo!r} is not supported with "
+                    "truncated BPTT; use stochastic_gradient_descent")
+            return self._do_truncated_bptt(inputs, labels, fmasks_d, lmasks_l)
         if algo not in ("stochastic_gradient_descent", "sgd"):
             return self._fit_one_solver(algo, inputs, labels, fmasks_d, lmasks_l)
         step_fn = self._get_train_step((len(inputs), len(labels),
@@ -453,6 +489,61 @@ class ComputationGraph:
             self.step += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.step)
+
+    def _do_truncated_bptt(self, inputs, labels, fmasks_d, lmasks_l):
+        """Sliding-window TBPTT over the DAG with carried RNN vertex state
+        (reference ComputationGraph.doTruncatedBPTT + backprop(tbptt):960).
+        2-D inputs/labels (static features / per-sequence targets) pass
+        through unwindowed; 3-D arrays window along time."""
+        T = max(a.shape[1] for a in inputs if a.ndim == 3)
+        L = self.conf.tbptt_fwd_length
+        batch = inputs[0].shape[0]
+        # state dtype = the network compute dtype (NOT input[0].dtype:
+        # the first input may be integer embedding indices)
+        dtype = _dtype_of(self.conf.conf)
+        states = {name: impl.init_state(batch, dtype)
+                  for name, impl in self._impls.items()
+                  if isinstance(impl, BaseRecurrentImpl)}
+        key = ("tbptt_step",)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(self._build_train_step_stateful(),
+                                           donate_argnums=(0, 2))
+        step_fn = self._jit_cache[key]
+
+        def win(a, start, end):
+            return a[:, start:end] if getattr(a, "ndim", 0) == 3 else a
+
+        def win_mask(m, start, end, is_sequence):
+            """Window a mask ONLY when its corresponding array is a time
+            series — a [B, 1] mask on a static input must pass through."""
+            if m is None or not is_sequence:
+                return m
+            return m[:, start:end] if m.ndim >= 2 else m
+
+        seq_input = {name: inputs[i].ndim == 3
+                     for i, name in enumerate(self.conf.network_inputs)}
+        seq_label = [y.ndim == 3 for y in labels]
+        start = 0
+        while start < T:
+            end = min(start + L, T)
+            ins = [win(a, start, end) for a in inputs]
+            labs = [win(y, start, end) for y in labels]
+            fms = ({k: win_mask(m, start, end, seq_input.get(k, False))
+                    for k, m in fmasks_d.items()} if fmasks_d else None)
+            lms = ([win_mask(m, start, end, seq_label[i])
+                    for i, m in enumerate(lmasks_l)]
+                   if lmasks_l else None)
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.variables, self.updater_state, loss,
+             states) = step_fn(self.params, self.variables,
+                               self.updater_state, jnp.asarray(self.step),
+                               sub, ins, labs, fms, lms, states)
+            states = jax.tree_util.tree_map(jax.lax.stop_gradient, states)
+            self._score_raw = loss
+            self.step += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.step)
+            start = end
 
     def _fit_one_solver(self, algo, inputs, labels, fmasks_d, lmasks_l):
         """Whole-graph training under CG / LBFGS / line-search — reference

@@ -1,0 +1,54 @@
+"""Character-level LSTM language model with truncated BPTT + sampling.
+
+Run: python examples/char_rnn.py [--steps N]
+"""
+import argparse
+
+import numpy as np
+
+from deeplearning4j_tpu.models.zoo import char_rnn_lstm
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+TEXT = ("the quick brown fox jumps over the lazy dog. "
+        "pack my box with five dozen liquor jugs. ") * 200
+
+
+def one_hot_text(text, stoi):
+    ids = np.array([stoi[c] for c in text])
+    return np.eye(len(stoi), dtype=np.float32)[ids]
+
+
+def main(steps: int = 30, seq_len: int = 50, batch: int = 32) -> float:
+    chars = sorted(set(TEXT))
+    stoi = {c: i for i, c in enumerate(chars)}
+    vocab = len(chars)
+    enc = one_hot_text(TEXT, stoi)
+
+    net = MultiLayerNetwork(char_rnn_lstm(vocab_size=vocab, hidden=128,
+                                          tbptt=seq_len)).init()
+    rng = np.random.default_rng(0)
+    for step in range(steps):
+        starts = rng.integers(0, len(TEXT) - seq_len - 1, batch)
+        x = np.stack([enc[s:s + seq_len] for s in starts])
+        y = np.stack([enc[s + 1:s + seq_len + 1] for s in starts])
+        net.fit(x, y)
+        if (step + 1) % 10 == 0:
+            print(f"step {step + 1}: loss={net.score_:.4f}")
+
+    # sample: greedy decode from a seed character (stateful rnn_time_step)
+    net.rnn_clear_previous_state()
+    idx = stoi["t"]
+    out_chars = ["t"]
+    for _ in range(40):
+        probs = np.asarray(net.rnn_time_step(
+            np.eye(vocab, dtype=np.float32)[None, None, idx][0][None]))[0, -1]
+        idx = int(np.argmax(probs))
+        out_chars.append(chars[idx])
+    print("sample:", "".join(out_chars))
+    return net.score_
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    main(p.parse_args().steps)

@@ -1,0 +1,37 @@
+"""Data-parallel distributed training over a device mesh.
+
+Run on any host (uses all visible devices; force a virtual mesh with
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8):
+  python examples/distributed_training.py
+"""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+from deeplearning4j_tpu.models.zoo import mlp_iris
+from deeplearning4j_tpu.parallel.spark_api import SparkDl4jMultiLayer
+from deeplearning4j_tpu.parallel.statetracker import TrainingStateTracker
+from deeplearning4j_tpu.parallel.trainer import IciDataParallelTrainingMaster
+
+
+def main(epochs: int = 40) -> float:
+    iris = load_iris_dataset()
+    batches = [DataSet(iris.features[i:i + 30], iris.labels[i:i + 30])
+               for i in range(0, 150, 30)]
+
+    # checkpoint-based fault tolerance: kill this process at any point and
+    # rerun — it resumes from the newest checkpoint
+    tracker = TrainingStateTracker("/tmp/dl4j_tpu_example_ckpt",
+                                   every_n_batches=20)
+    master = IciDataParallelTrainingMaster(state_tracker=tracker)
+    spark_net = SparkDl4jMultiLayer(mlp_iris(), training_master=master)
+    master.resume(spark_net.get_network())
+    for _ in range(epochs):
+        spark_net.fit(batches)
+    acc = spark_net.evaluate(batches).accuracy()
+    print(f"accuracy after {epochs} distributed epochs: {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

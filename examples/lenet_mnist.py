@@ -1,0 +1,31 @@
+"""LeNet on MNIST — the canonical image-classification example.
+
+Run: python examples/lenet_mnist.py [--epochs N]
+(MNIST IDX files in ~/.dl4j_tpu_data are used if present; otherwise an
+offline digits stand-in keeps the example runnable anywhere.)
+"""
+import argparse
+
+from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
+from deeplearning4j_tpu.models.zoo import lenet_mnist
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+
+def main(epochs: int = 4, num_examples: int = 2048, batch: int = 256) -> float:
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    net.set_listeners(ScoreIterationListener(10, log_fn=print))
+    train = MnistDataSetIterator(batch=batch, num_examples=num_examples)
+    for epoch in range(epochs):
+        train.reset()
+        net.fit(train)
+        train.reset()
+        acc = net.evaluate(train).accuracy()
+        print(f"epoch {epoch + 1}: accuracy={acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    main(p.parse_args().epochs)

@@ -1,0 +1,38 @@
+"""Train, checkpoint, serve over HTTP, and query — the full serving loop.
+
+Run: python examples/model_serving.py
+"""
+import json
+import urllib.request
+
+from deeplearning4j_tpu.datasets.fetchers import load_iris_dataset
+from deeplearning4j_tpu.models.zoo import mlp_iris
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import InferenceServer
+from deeplearning4j_tpu.util.model_serializer import write_model
+
+
+def main() -> int:
+    iris = load_iris_dataset()
+    net = MultiLayerNetwork(mlp_iris()).init()
+    for _ in range(40):
+        net.fit_batch(iris.features, iris.labels)
+    write_model(net, "/tmp/dl4j_tpu_example_model.zip")
+
+    server = InferenceServer(
+        model_path="/tmp/dl4j_tpu_example_model.zip").start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"data": iris.features[:5].tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read())
+        print("predicted classes:", out["classes"])
+        return len(out["classes"])
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

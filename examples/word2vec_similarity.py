@@ -1,0 +1,40 @@
+"""Word2Vec skip-gram embeddings + nearest-word queries.
+
+Run: python examples/word2vec_similarity.py
+"""
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def make_corpus(n=2000, seed=7):
+    rng = np.random.default_rng(seed)
+    topics = [["cat", "dog", "pet", "fur", "paw", "tail"],
+              ["car", "truck", "road", "wheel", "engine", "fuel"],
+              ["sun", "moon", "star", "sky", "cloud", "rain"]]
+    out = []
+    for _ in range(n):
+        group = topics[rng.integers(0, len(topics))]
+        out.append(" ".join(group[i] for i in rng.integers(0, len(group), 8)))
+    return out
+
+
+def main() -> float:
+    w2v = (Word2Vec.builder()
+           .layer_size(64).window_size(4).negative_sample(5)
+           .min_word_frequency(2).epochs(8).learning_rate(0.05)
+           .seed(1).batch_size(2048)
+           .iterate(make_corpus())
+           .build())
+    w2v.fit()
+    print(f"trained at {w2v.words_per_sec_:,.0f} words/sec")
+    for w in ("cat", "car", "sun"):
+        print(f"nearest({w}) = {w2v.words_nearest(w, 3)}")
+    sim = w2v.similarity("cat", "dog")
+    print(f"similarity(cat, dog) = {sim:.3f} "
+          f"vs similarity(cat, wheel) = {w2v.similarity('cat', 'wheel'):.3f}")
+    return sim
+
+
+if __name__ == "__main__":
+    main()

@@ -260,3 +260,43 @@ def test_graph_fit_iterator_chunked():
     net.fit(batches)
     assert net.step == 7  # 2 full scan chunks (3+3) + 1 single step
     assert np.isfinite(net.score_)
+
+
+def test_graph_truncated_bptt():
+    """Graph TBPTT (reference ComputationGraph.backprop(tbptt):960):
+    sequences longer than tbptt_fwd_length split into windows with carried
+    RNN vertex state; one optimization step per window."""
+    from deeplearning4j_tpu.nn.conf.config import BACKPROP_TBPTT
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=6, n_out=12, activation="tanh"),
+                       "in")
+            .add_layer("out", RnnOutputLayer(n_in=12, n_out=6,
+                                             activation="softmax",
+                                             loss="mcxent"), "lstm")
+            .set_outputs("out")
+            .backprop_type(BACKPROP_TBPTT)
+            .t_bptt_forward_length(8).t_bptt_backward_length(8)
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 20, 6)).astype(np.float32)   # T=20 -> 3 windows
+    y = np.eye(6, dtype=np.float32)[rng.integers(0, 6, (4, 20))]
+    net.fit([x], [y])
+    assert net.step == 3  # ceil(20/8) windows, one step each
+    assert np.isfinite(net.score_)
+    first = net.score_
+    for _ in range(10):
+        net.fit([x], [y])
+    assert net.score_ < first  # learns through the windowed path
+    # stateful streaming inference still works after TBPTT training
+    out = net.rnn_time_step(x[:, :1])
+    assert out[0].shape == (4, 1, 6)
+    # fit_scan refuses TBPTT configs instead of silently unwindowing
+    import pytest
+    with pytest.raises(ValueError):
+        net.fit_scan([np.tile(x[None], (2, 1, 1, 1))],
+                     [np.tile(y[None], (2, 1, 1, 1))])

@@ -1,0 +1,36 @@
+"""Every example in examples/ must run end-to-end (tiny settings)."""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
+
+
+def test_lenet_mnist_example():
+    import lenet_mnist
+    acc = lenet_mnist.main(epochs=1, num_examples=256, batch=64)
+    assert 0.0 <= acc <= 1.0
+
+
+def test_char_rnn_example():
+    import char_rnn
+    loss = char_rnn.main(steps=4, seq_len=16, batch=8)
+    assert loss > 0
+
+
+def test_word2vec_example():
+    import word2vec_similarity
+    sim = word2vec_similarity.main()
+    assert -1.0 <= sim <= 1.0
+
+
+def test_distributed_example():
+    import shutil
+    shutil.rmtree("/tmp/dl4j_tpu_example_ckpt", ignore_errors=True)
+    import distributed_training
+    acc = distributed_training.main(epochs=10)
+    assert acc > 0.3
+
+
+def test_serving_example():
+    import model_serving
+    assert model_serving.main() == 5
