@@ -173,6 +173,41 @@ def main() -> None:
         finally:
             pallas_kernels.disable()
 
+    # ---- 4b. Transformer LM (beyond the reference: the long-context
+    # workload this framework adds — causal attention + LayerNorm +
+    # residual graph vertices; see models/zoo.transformer_lm) -------------
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    Vt, Tt, Bt = 128, 256, 32
+    gnet = ComputationGraph(transformer_lm(vocab_size=Vt, d_model=512,
+                                           n_heads=8, n_blocks=4,
+                                           dtype=dtype)).init()
+    ids = rng.integers(0, Vt, (8, Bt, Tt + 1))
+    gxs = jnp.asarray(np.eye(Vt, dtype=np.float32)[ids[:, :, :-1]])
+    gys = jnp.asarray(np.eye(Vt, dtype=np.float32)[ids[:, :, 1:]])
+    gsf = gnet._get_train_step((1, 1, False, False))
+    gfl = _flops_of(gsf, gnet.params, gnet.variables, gnet.updater_state,
+                    jnp.asarray(0), jax.random.PRNGKey(0), [gxs[0]],
+                    [gys[0]], None, None)
+    gl = gnet.fit_scan([gxs], [gys])
+    tr_first = float(gl[0])
+    _ = float(gnet.fit_scan([gxs], [gys])[-1])
+    t0 = time.perf_counter()
+    for _i in range(16):
+        gl = gnet.fit_scan([gxs], [gys])
+    _ = float(gl[-1])
+    tr_dt = (time.perf_counter() - t0) / (16 * 8)
+    WORKLOADS["transformer_lm"] = {
+        "examples_per_sec": round(Bt / tr_dt, 1),
+        "tokens_per_sec": round(Bt * Tt / tr_dt, 1),
+        "step_ms": round(tr_dt * 1e3, 3),
+        "mfu": round(gfl / tr_dt / PEAK_FLOPS[dtype], 4) if gfl else None,
+        "flops_per_step": gfl,
+        "loss_first": round(tr_first, 4),
+        "loss_last": round(float(gl[-1]), 4),
+        "config": "d_model=512 n_blocks=4 n_heads=8 T=256 B=32 causal",
+    }
+
     # ---- 5. Word2Vec skip-gram words/sec (synthetic zipf corpus; text8 is
     # unfetchable here — zero egress) -----------------------------------------
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec

@@ -309,7 +309,9 @@ def _layer_wants(layer: Layer) -> str:
         return "convolutional"
     if isinstance(layer, (BaseRecurrentLayer, RnnOutputLayer, SelfAttentionLayer)):
         return "recurrent"
-    if isinstance(layer, (ActivationLayer, DropoutLayer, BatchNormalization, GlobalPoolingLayer)):
+    from .layers import LayerNormalization
+    if isinstance(layer, (ActivationLayer, DropoutLayer, BatchNormalization,
+                          LayerNormalization, GlobalPoolingLayer)):
         return "any"
     return "feedforward"
 

@@ -201,6 +201,29 @@ class BatchNormalization(FeedForwardLayer):
 
 @register
 @dataclass
+class LayerNormalization(FeedForwardLayer):
+    """Layer norm over the trailing feature axis (no 0.4-era reference
+    counterpart — added alongside SelfAttentionLayer as the transformer
+    building block; normalizes each example independently, so it is
+    batch-size- and sequence-parallel-friendly on TPU)."""
+
+    eps: float = 1e-5
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            if isinstance(input_type, ConvolutionalInputType):
+                self.n_in = input_type.channels
+            else:
+                self.n_in = input_type.flat_size()
+        if self.n_out is None:
+            self.n_out = self.n_in
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+
+@register
+@dataclass
 class LocalResponseNormalization(Layer):
     """LRN across channels (reference nn/conf/layers/LocalResponseNormalization.java)."""
 

@@ -407,9 +407,14 @@ class ComputationGraph:
         if not self._can_scan():
             raise ValueError("fit_scan requires SGD-class training "
                              "(iterations=1, scan_batches>1)")
-        if self.conf.backprop_type == BACKPROP_TBPTT:
-            raise ValueError("fit_scan does not window TBPTT sequences; "
-                             "use fit() for truncated-BPTT graphs")
+        if (self.conf.backprop_type == BACKPROP_TBPTT
+                and any(getattr(a, "ndim", 0) == 4
+                        and a.shape[2] > self.conf.tbptt_fwd_length
+                        for a in xs_list)):
+            raise ValueError(
+                "fit_scan does not window TBPTT sequences longer than "
+                f"tbptt_fwd_length={self.conf.tbptt_fwd_length}; "
+                "pass single windows or use fit()")
         xs_list = [jnp.asarray(a) for a in xs_list]
         ys_list = [jnp.asarray(a) for a in ys_list]
         cache_key = ("multi", len(xs_list), len(ys_list))

@@ -68,3 +68,26 @@ class LocalResponseNormalizationImpl(LayerImpl):
     def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
         c = self.conf
         return ophelpers.lrn(x, k=c.k, n=c.n, alpha=c.alpha, beta=c.beta), variables or {}
+
+
+@register_impl("LayerNormalization")
+class LayerNormalizationImpl(LayerImpl):
+    """Per-example normalization over the trailing feature axis with learned
+    gain/bias (transformer building block — see conf LayerNormalization)."""
+
+    def init_params(self, key, dtype=jnp.float32):
+        n = self.conf.n_out or self.conf.n_in
+        return {"gain": jnp.ones((n,), dtype),
+                "beta": jnp.zeros((n,), dtype)}
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None,
+                mask=None):
+        conf = self.conf
+        x = self._dropout(x, train, rng)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + jnp.asarray(conf.eps, x.dtype))
+        y = y * params["gain"] + params["beta"]
+        if conf.activation not in (None, "identity", "linear"):
+            y = self.activation_fn()(y)
+        return y, variables or {}

@@ -40,8 +40,8 @@ def main(steps: int = 30, seq_len: int = 50, batch: int = 32) -> float:
     idx = stoi["t"]
     out_chars = ["t"]
     for _ in range(40):
-        probs = np.asarray(net.rnn_time_step(
-            np.eye(vocab, dtype=np.float32)[None, None, idx][0][None]))[0, -1]
+        x_step = np.eye(vocab, dtype=np.float32)[idx][None, None]  # [1,1,V]
+        probs = np.asarray(net.rnn_time_step(x_step))[0, -1]
         idx = int(np.argmax(probs))
         out_chars.append(chars[idx])
     print("sample:", "".join(out_chars))

@@ -20,9 +20,10 @@ def main(epochs: int = 40) -> float:
                for i in range(0, 150, 30)]
 
     # checkpoint-based fault tolerance: kill this process at any point and
-    # rerun — it resumes from the newest checkpoint
-    tracker = TrainingStateTracker("/tmp/dl4j_tpu_example_ckpt",
-                                   every_n_batches=20)
+    # rerun with the same ckpt_dir — it resumes from the newest checkpoint
+    import tempfile
+    ckpt_dir = tempfile.mkdtemp(prefix="dl4j_tpu_example_ckpt_")
+    tracker = TrainingStateTracker(ckpt_dir, every_n_batches=20)
     master = IciDataParallelTrainingMaster(state_tracker=tracker)
     spark_net = SparkDl4jMultiLayer(mlp_iris(), training_master=master)
     master.resume(spark_net.get_network())

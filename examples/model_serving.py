@@ -13,14 +13,16 @@ from deeplearning4j_tpu.util.model_serializer import write_model
 
 
 def main() -> int:
+    import tempfile
+    from pathlib import Path
     iris = load_iris_dataset()
     net = MultiLayerNetwork(mlp_iris()).init()
     for _ in range(40):
         net.fit_batch(iris.features, iris.labels)
-    write_model(net, "/tmp/dl4j_tpu_example_model.zip")
+    model_path = Path(tempfile.mkdtemp()) / "model.zip"
+    write_model(net, model_path)
 
-    server = InferenceServer(
-        model_path="/tmp/dl4j_tpu_example_model.zip").start()
+    server = InferenceServer(model_path=model_path).start()
     try:
         base = f"http://127.0.0.1:{server.port}"
         req = urllib.request.Request(

@@ -24,8 +24,6 @@ def test_word2vec_example():
 
 
 def test_distributed_example():
-    import shutil
-    shutil.rmtree("/tmp/dl4j_tpu_example_ckpt", ignore_errors=True)
     import distributed_training
     acc = distributed_training.main(epochs=10)
     assert acc > 0.3
