@@ -195,10 +195,12 @@ def _fit_with_recovery_loop(net, make_iterator, epochs, tracker, master,
                 continue  # already trained before the checkpoint
             if master is not None:
                 master.execute_training(net, [ds])
-            else:
+            elif hasattr(net, "fit_batch"):  # MultiLayerNetwork
                 net.fit_batch(ds.features, ds.labels,
                               getattr(ds, "features_mask", None),
                               getattr(ds, "labels_mask", None))
+            else:  # ComputationGraph: one (Multi)DataSet through fit
+                net.fit(ds)
             bi += 1
             tracker.batch_done(net, {"epoch": epoch, "batch": bi})
         start_batch = 0

@@ -213,3 +213,41 @@ def test_ici_master_resume(tmp_path):
     m2.execute_training(net2, batches())
     np.testing.assert_allclose(ref.params_flat(), net2.params_flat(),
                                atol=1e-6)
+
+
+def test_graph_resume_reaches_identical_state(tmp_path):
+    """fit_with_recovery works for ComputationGraph too (checkpoint via the
+    same flat-view contract)."""
+    from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(4).learning_rate(0.1)
+                .graph_builder().add_inputs("in")
+                .add_layer("h", DenseLayer(n_in=4, n_out=8,
+                                           activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                              activation="softmax",
+                                              loss="negativeloglikelihood"),
+                           "h")
+                .set_outputs("out").build())
+        return ComputationGraph(conf).init()
+
+    ref = build()
+    t0 = TrainingStateTracker(tmp_path / "gref", every_n_batches=4)
+    fit_with_recovery(ref, _make_iterator, epochs=2, tracker=t0)
+
+    # every_n_batches=4 with 6 batches/epoch: the newest checkpoint lands
+    # MID-epoch (batch 4), so resume must replay the lost tail (5-6)
+    net = build()
+    tracker = TrainingStateTracker(tmp_path / "gint", every_n_batches=4)
+    it = _make_iterator(0)
+    for bi, ds in enumerate(it):
+        net.fit(ds)
+        tracker.batch_done(net, {"epoch": 0, "batch": bi + 1})
+    del net  # crash
+
+    net2 = build()
+    fit_with_recovery(net2, _make_iterator, epochs=2, tracker=tracker)
+    np.testing.assert_array_equal(ref.params_flat(), net2.params_flat())
