@@ -1,0 +1,144 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+No reference counterpart (the reference is data-parallel only — SURVEY.md
+§2.4); this is part of the TPU-native distributed design the north star
+calls for (dp/tp/sp in parallel/{trainer,ring}.py; pp here).
+
+Design: the classic JAX "collective pipeline" — stages live on the devices
+of a `pipe` mesh axis; a `lax.scan` over S+M-1 ticks moves activations
+between neighbouring stages with `lax.ppermute`, stage 0 injects a new
+microbatch each tick, the last stage emits results. Because the schedule is
+expressed as pure collectives inside `shard_map`, `jax.grad` differentiates
+straight through it — the reverse-order backward pipeline (GPipe's backward
+schedule) falls out of autodiff, no hand-written bwd pass.
+
+Scope: homogeneous block stacks (every stage runs the same `block_fn` with
+the same activation shape) — exactly the transformer-block regime pipeline
+parallelism is used for in practice. Params are stacked [S, ...] and
+sharded one stage per device along `pipe`.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+
+def stack_block_params(params_list):
+    """Stack per-stage param pytrees into one [S, ...] pytree."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def gpipe_spmd_fn(block_fn: Callable, n_stages: int, n_micro: int,
+                  axis: str = "pipe"):
+    """Build the per-device SPMD pipeline body (to be wrapped in shard_map).
+
+    Inputs (per-device view):
+      stage_params: [1, ...] pytree — this device's stage slice
+      xs:           [M, B, ...] microbatches (replicated; only stage 0 reads)
+    Returns:
+      ys:           [M, B, ...] pipeline outputs (valid on every device —
+                    the last stage's results are broadcast with a psum so
+                    downstream loss code is stage-agnostic)
+    """
+    S, M = n_stages, n_micro
+
+    def body(stage_params, xs):
+        s = jax.lax.axis_index(axis)
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        buf0 = jnp.zeros_like(xs[0])
+        outs0 = jnp.zeros_like(xs)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            buf, outs = carry
+            recv = jax.lax.ppermute(buf, axis, perm)
+            m_in = jnp.clip(t, 0, M - 1)
+            inject = jnp.where((s == 0) & (t < M), 1.0, 0.0).astype(xs.dtype)
+            inp = inject * jax.lax.dynamic_index_in_dim(
+                xs, m_in, keepdims=False) + (1 - inject) * recv
+            out = block_fn(my_params, inp)
+            # the LAST stage finished microbatch m = t - (S-1) at this tick
+            m_out = t - (S - 1)
+            valid = (s == S - 1) & (m_out >= 0) & (m_out < M)
+            upd = jnp.where(valid, 1.0, 0.0).astype(outs.dtype)
+            slot = jnp.clip(m_out, 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, upd * out + (1 - upd) * cur, slot, 0)
+            return (out, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (buf0, outs0),
+                                    jnp.arange(S + M - 1))
+        # broadcast the last stage's outputs to every stage (loss code runs
+        # replicated); non-last stages contribute zeros
+        mine = jnp.where(s == S - 1, 1.0, 0.0).astype(outs.dtype)
+        return jax.lax.psum(outs * mine, axis)
+
+    return body
+
+
+class GPipeExecutor:
+    """Pipelined apply/train over a homogeneous block stack.
+
+    block_fn(params, x) -> y must preserve x's shape (transformer-block
+    regime). Parameters live stacked [S, ...], sharded one stage per device
+    of the mesh's `pipe` axis.
+    """
+
+    def __init__(self, block_fn: Callable, n_stages: int, n_micro: int,
+                 mesh: Mesh, axis: str = "pipe"):
+        if mesh.shape[axis] != n_stages:
+            raise ValueError(f"mesh axis {axis!r} has {mesh.shape[axis]} "
+                             f"devices, need n_stages={n_stages}")
+        self.block_fn = block_fn
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        self.mesh = mesh
+        self.axis = axis
+        body = gpipe_spmd_fn(block_fn, n_stages, n_micro, axis)
+        self._apply = jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis), P()),  # params stage-sharded, data replicated
+            out_specs=P(),
+            check_vma=False,
+        ))
+
+    def shard_params(self, stacked_params):
+        """Place a stacked [S, ...] param pytree one stage per device."""
+        sh = NamedSharding(self.mesh, P(self.axis))
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.asarray(a), sh), stacked_params)
+
+    def apply(self, stacked_params, x, *, microbatch: bool = True) -> Array:
+        """Run the stack over x ([B, ...] or pre-split [M, b, ...])."""
+        if microbatch:
+            B = x.shape[0]
+            if B % self.n_micro:
+                raise ValueError(f"batch {B} not divisible by "
+                                 f"n_micro={self.n_micro}")
+            xs = x.reshape((self.n_micro, B // self.n_micro) + x.shape[1:])
+        else:
+            if x.shape[0] != self.n_micro:
+                raise ValueError(
+                    f"pre-split input has {x.shape[0]} microbatches; "
+                    f"executor was built with n_micro={self.n_micro}")
+            xs = x
+        ys = self._apply(stacked_params, xs)
+        return ys.reshape((-1,) + ys.shape[2:]) if microbatch else ys
+
+    def grad_fn(self, loss_fn: Callable):
+        """Build d(loss)/d(params) through the pipeline: loss_fn(y, target)
+        over the pipelined outputs. Autodiff reverses the schedule (the
+        GPipe backward pipeline) automatically."""
+
+        def objective(stacked_params, x, target):
+            y = self.apply(stacked_params, x)
+            return loss_fn(y, target)
+
+        return jax.jit(jax.value_and_grad(objective))
