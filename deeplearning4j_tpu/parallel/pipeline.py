@@ -51,7 +51,13 @@ def gpipe_spmd_fn(block_fn: Callable, n_stages: int, n_micro: int,
     def body(stage_params, xs):
         s = jax.lax.axis_index(axis)
         my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
-        buf0 = jnp.zeros_like(xs[0])
+        # Bubble ticks run the block on whatever sits in the buffer; the
+        # results are masked out, BUT degenerate inputs (all-zeros) can
+        # produce NaN forward intermediates in blocks with normalization
+        # (std(0) has a NaN gradient), and 0 * NaN = NaN poisons the
+        # parameter cotangents. Seed the buffer with a REAL microbatch so
+        # every bubble computation is numerically ordinary.
+        buf0 = xs[0]
         outs0 = jnp.zeros_like(xs)
         perm = [(i, (i + 1) % S) for i in range(S)]
 

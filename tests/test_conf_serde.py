@@ -110,3 +110,27 @@ def test_batchnorm_shape_inference():
             .build())
     assert conf.layers[1].n_out == 8  # per-channel
     assert conf.layers[2].n_in == 8 * 8 * 8
+
+
+def test_new_layer_configs_serde_roundtrip():
+    """Round-3 layer configs (SelfAttention, LayerNormalization) survive
+    JSON and YAML round trips through the @class-discriminated serde."""
+    from deeplearning4j_tpu.nn.conf.layers import (LayerNormalization,
+                                                   SelfAttentionLayer)
+    conf = (NeuralNetConfiguration.builder().seed(1).learning_rate(0.01)
+            .list()
+            .layer(SelfAttentionLayer(n_in=8, n_out=16, n_heads=4,
+                                      causal=True, activation="identity"))
+            .layer(LayerNormalization(n_in=16, n_out=16,
+                                      activation="identity"))
+            .layer(DenseLayer(n_in=16, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    j = conf.to_json()
+    back = MultiLayerConfiguration.from_json(j)
+    assert back.to_json() == j
+    assert type(back.layers[0]).__name__ == "SelfAttentionLayer"
+    assert back.layers[0].causal is True and back.layers[0].n_heads == 4
+    assert type(back.layers[1]).__name__ == "LayerNormalization"
+    assert MultiLayerConfiguration.from_yaml(conf.to_yaml()).to_json() == j
