@@ -1,0 +1,100 @@
+"""Dataset auto-download (reference MnistDataFetcher.java:68 downloads the
+IDX archives on first use; base/MnistFetcher + CifarDataFetcher likewise).
+
+Opt-in by design: this build targets zero-egress environments, so fetchers
+only attempt network downloads when `DL4J_TPU_DOWNLOAD=1` is set (or
+`allow_download=True` is passed). Downloads are atomic (tmp + rename),
+optionally checksum-verified, and gunzip .gz payloads on request.
+"""
+from __future__ import annotations
+
+import gzip
+import hashlib
+import os
+import shutil
+import urllib.request
+from pathlib import Path
+from typing import Optional
+
+#: canonical dataset sources (the reference's hard-coded URLs, modernized)
+MNIST_URLS = {
+    "train-images-idx3-ubyte": "https://storage.googleapis.com/cvdf-datasets/mnist/train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte": "https://storage.googleapis.com/cvdf-datasets/mnist/train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte": "https://storage.googleapis.com/cvdf-datasets/mnist/t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte": "https://storage.googleapis.com/cvdf-datasets/mnist/t10k-labels-idx1-ubyte.gz",
+}
+
+
+def downloads_enabled() -> bool:
+    return os.environ.get("DL4J_TPU_DOWNLOAD", "0") == "1"
+
+
+def download(url: str, dest: Path, sha256: Optional[str] = None,
+             gunzip: bool = False, timeout: float = 30.0) -> Path:
+    """Fetch url -> dest atomically; verify checksum; optionally gunzip.
+    The temp name is unique per call, so concurrent downloaders (multiple
+    hosts on a shared data dir) cannot interleave into one file, and a
+    failed attempt never strands a partial file."""
+    import uuid
+    dest = Path(dest)
+    if dest.exists():
+        return dest
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tag = uuid.uuid4().hex[:12]
+    tmp = dest.with_name(f".{dest.name}.{tag}.part")
+    plain = dest.with_name(f".{dest.name}.{tag}.plain")
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp, \
+                open(tmp, "wb") as out:
+            shutil.copyfileobj(resp, out)
+        if sha256 is not None:
+            h = hashlib.sha256(tmp.read_bytes()).hexdigest()
+            if h != sha256:
+                raise IOError(f"checksum mismatch for {url}: {h} != {sha256}")
+        if gunzip:
+            with gzip.open(tmp, "rb") as fin, open(plain, "wb") as fout:
+                shutil.copyfileobj(fin, fout)
+            os.replace(plain, dest)
+        else:
+            os.replace(tmp, dest)
+        return dest
+    finally:
+        tmp.unlink(missing_ok=True)
+        plain.unlink(missing_ok=True)
+
+
+_failed_urls: set = set()  # per-process negative cache: no repeated stalls
+
+
+def fetch_mnist(data_dir: Path, train: bool = True,
+                urls: Optional[dict] = None,
+                allow_download: Optional[bool] = None) -> Optional[tuple]:
+    """Download the MNIST IDX pair into data_dir if allowed. Returns
+    (images_path, labels_path) or None when downloads are disabled or
+    fail (callers fall back to the offline stand-in; the failure is
+    WARNED when the user explicitly opted into downloads, so nobody
+    silently trains on the stand-in believing it is MNIST)."""
+    if allow_download is None:
+        allow_download = downloads_enabled()
+    if not allow_download:
+        return None
+    urls = urls or MNIST_URLS
+    prefix = "train" if train else "t10k"
+    img_name = f"{prefix}-images-idx3-ubyte"
+    lbl_name = f"{prefix}-labels-idx1-ubyte"
+    img_url, lbl_url = urls[img_name], urls[lbl_name]
+    if img_url in _failed_urls or lbl_url in _failed_urls:
+        return None  # this URL already failed in this process
+    try:
+        img = download(img_url, Path(data_dir) / img_name,
+                       gunzip=img_url.endswith(".gz"))
+        lbl = download(lbl_url, Path(data_dir) / lbl_name,
+                       gunzip=lbl_url.endswith(".gz"))
+        return img, lbl
+    except Exception as e:  # graceful offline fallback, but LOUD
+        import warnings
+        _failed_urls.update((img_url, lbl_url))
+        warnings.warn(f"MNIST download failed ({e!r}); falling back to the "
+                      "offline digits stand-in. Unset DL4J_TPU_DOWNLOAD or "
+                      "fix connectivity to silence this.")
+        return None

@@ -115,7 +115,10 @@ def _find_mnist(train: bool) -> Optional[Tuple[Path, Path]]:
             ip, lp = base / img_name, base / lab_name
             if ip.exists() and lp.exists():
                 return ip, lp
-    return None
+    # auto-download (reference MnistDataFetcher.java:68) — opt-in via
+    # DL4J_TPU_DOWNLOAD=1; silently unavailable in zero-egress environments
+    from .downloader import fetch_mnist
+    return fetch_mnist(base, train)
 
 
 def _digits_as_mnist(num: int, train: bool, binarize: bool) -> DataSet:

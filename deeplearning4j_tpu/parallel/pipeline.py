@@ -51,32 +51,36 @@ def gpipe_spmd_fn(block_fn: Callable, n_stages: int, n_micro: int,
     def body(stage_params, xs):
         s = jax.lax.axis_index(axis)
         my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
-        # Bubble ticks run the block on whatever sits in the buffer; the
-        # results are masked out, BUT degenerate inputs (all-zeros) can
-        # produce NaN forward intermediates in blocks with normalization
-        # (std(0) has a NaN gradient), and 0 * NaN = NaN poisons the
-        # parameter cotangents. Seed the buffer with a REAL microbatch so
-        # every bubble computation is numerically ordinary.
-        buf0 = xs[0]
+        buf0 = jnp.zeros_like(xs[0])
         outs0 = jnp.zeros_like(xs)
         perm = [(i, (i + 1) % S) for i in range(S)]
+        # Bubble ticks still run block_fn; their results are discarded, BUT
+        # a degenerate input (e.g. all zeros) can create NaN forward
+        # intermediates in normalized blocks (std(0) has a 0/0 gradient),
+        # and 0 * NaN = NaN then poisons parameter cotangents. So bubble
+        # ticks compute on a GUARANTEED-nondegenerate synthetic input
+        # (iota-patterned, nonzero variance), selected with jnp.where —
+        # whose VJP routes zero cotangent to the unselected branch.
+        flat = jnp.arange(int(np.prod(xs[0].shape)), dtype=jnp.float32)
+        safe = ((flat % 7.0) - 3.0).reshape(xs[0].shape).astype(xs.dtype)
 
         def tick(carry, t):
             buf, outs = carry
             recv = jax.lax.ppermute(buf, axis, perm)
             m_in = jnp.clip(t, 0, M - 1)
-            inject = jnp.where((s == 0) & (t < M), 1.0, 0.0).astype(xs.dtype)
-            inp = inject * jax.lax.dynamic_index_in_dim(
-                xs, m_in, keepdims=False) + (1 - inject) * recv
+            injected = jax.lax.dynamic_index_in_dim(xs, m_in, keepdims=False)
+            inp_raw = jnp.where((s == 0) & (t < M), injected, recv)
+            # stage s carries real data exactly during ticks [s, s+M)
+            live = (t >= s) & (t < s + M)
+            inp = jnp.where(live, inp_raw, safe)
             out = block_fn(my_params, inp)
             # the LAST stage finished microbatch m = t - (S-1) at this tick
             m_out = t - (S - 1)
             valid = (s == S - 1) & (m_out >= 0) & (m_out < M)
-            upd = jnp.where(valid, 1.0, 0.0).astype(outs.dtype)
             slot = jnp.clip(m_out, 0, M - 1)
             cur = jax.lax.dynamic_index_in_dim(outs, slot, keepdims=False)
             outs = jax.lax.dynamic_update_index_in_dim(
-                outs, upd * out + (1 - upd) * cur, slot, 0)
+                outs, jnp.where(valid, out, cur), slot, 0)
             return (out, outs), None
 
         (_, outs), _ = jax.lax.scan(tick, (buf0, outs0),
