@@ -86,10 +86,15 @@ def fetch_mnist(data_dir: Path, train: bool = True,
     if img_url in _failed_urls or lbl_url in _failed_urls:
         return None  # this URL already failed in this process
     try:
-        img = download(img_url, Path(data_dir) / img_name,
-                       gunzip=img_url.endswith(".gz"))
-        lbl = download(lbl_url, Path(data_dir) / lbl_name,
-                       gunzip=lbl_url.endswith(".gz"))
+        # keep the server's .gz form — the IDX readers open .gz natively
+        img_dest = Path(data_dir) / (
+            img_name + (".gz" if img_url.endswith(".gz") else ""))
+        lbl_dest = Path(data_dir) / (
+            lbl_name + (".gz" if lbl_url.endswith(".gz") else ""))
+        img = download(img_url, img_dest)
+        _verify_idx(img, ndim=3)
+        lbl = download(lbl_url, lbl_dest)
+        _verify_idx(lbl, ndim=1)
         return img, lbl
     except Exception as e:  # graceful offline fallback, but LOUD
         import warnings
@@ -98,3 +103,33 @@ def fetch_mnist(data_dir: Path, train: bool = True,
                       "offline digits stand-in. Unset DL4J_TPU_DOWNLOAD or "
                       "fix connectivity to silence this.")
         return None
+
+
+def _verify_idx(path: Path, ndim: int) -> None:
+    """Structural validation of a downloaded IDX file: correct magic, u8
+    payload, expected rank, and a payload matching the declared dims —
+    catches truncated/HTML/wrong-file responses without relying on
+    hard-coded mirror checksums. Deletes the file on failure so a bad
+    download is never cached."""
+    import struct
+    opener = gzip.open if str(path).endswith(".gz") else open
+    try:
+        with opener(path, "rb") as f:
+            zero, dtype_code, nd = struct.unpack(">HBB", f.read(4))
+            if zero != 0 or dtype_code != 0x08 or nd != ndim:
+                raise IOError(f"{path}: not a u8 rank-{ndim} IDX file")
+            dims = struct.unpack(">" + "I" * nd, f.read(4 * nd))
+            want = 1
+            for d in dims:
+                want *= d
+            got = 0
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                got += len(chunk)
+            if got != want:
+                raise IOError(f"{path}: payload {got} != declared {want}")
+    except Exception:
+        path.unlink(missing_ok=True)
+        raise

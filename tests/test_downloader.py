@@ -109,3 +109,23 @@ def test_download_disabled_by_default(monkeypatch, tmp_path):
     assert fetch_mnist(tmp_path, train=True, urls={
         "train-images-idx3-ubyte": "http://127.0.0.1:9/none.gz",
         "train-labels-idx1-ubyte": "http://127.0.0.1:9/none.gz"}) is None
+
+
+def test_fetch_mnist_rejects_corrupt_payload(tmp_path):
+    """Structural IDX validation: a wrong/truncated body (e.g. an HTML
+    error page served with HTTP 200) is rejected AND not cached."""
+    srv = _Server({
+        "train-images-idx3-ubyte.gz": gzip.compress(b"<html>mirror moved"),
+        "train-labels-idx1-ubyte.gz": gzip.compress(b"nope"),
+    })
+    try:
+        urls = {"train-images-idx3-ubyte":
+                srv.url("train-images-idx3-ubyte.gz"),
+                "train-labels-idx1-ubyte":
+                srv.url("train-labels-idx1-ubyte.gz")}
+        with pytest.warns(UserWarning):
+            assert fetch_mnist(tmp_path, train=True, urls=urls,
+                               allow_download=True) is None
+        assert not list(tmp_path.glob("*ubyte*"))  # bad files deleted
+    finally:
+        srv.stop()

@@ -168,3 +168,26 @@ def test_global_pooling_gradients(x64):
                OutputLayer(n_in=4, n_out=2, activation="softmax", loss="mcxent"))
     x = _rand((3, 4, 3))
     assert check_gradients(net, x, _onehot(3, 2), EPS, MAX_REL)
+
+
+def test_self_attention_gradients(x64):
+    from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+    net = _net(SelfAttentionLayer(n_in=4, n_out=8, n_heads=2, causal=True,
+                                  activation="identity"),
+               GlobalPoolingLayer(pooling_type="avg"),
+               OutputLayer(n_in=8, n_out=3, activation="softmax",
+                           loss="negativeloglikelihood"))
+    x = _rand((3, 5, 4))
+    y = _onehot(3, 3)
+    assert check_gradients(net, x, y, epsilon=EPS, max_rel_error=MAX_REL)
+
+
+def test_layer_norm_gradients(x64):
+    from deeplearning4j_tpu.nn.conf.layers import LayerNormalization
+    net = _net(DenseLayer(n_in=4, n_out=6, activation="identity"),
+               LayerNormalization(n_in=6, n_out=6, activation="tanh"),
+               OutputLayer(n_in=6, n_out=3, activation="softmax",
+                           loss="mcxent"))
+    x = _rand((8, 4))
+    y = _onehot(8, 3)
+    assert check_gradients(net, x, y, epsilon=EPS, max_rel_error=MAX_REL)
