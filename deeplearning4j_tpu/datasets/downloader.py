@@ -111,14 +111,13 @@ def _verify_idx(path: Path, ndim: int) -> None:
     catches truncated/HTML/wrong-file responses without relying on
     hard-coded mirror checksums. Deletes the file on failure so a bad
     download is never cached."""
-    import struct
     opener = gzip.open if str(path).endswith(".gz") else open
     try:
         with opener(path, "rb") as f:
-            zero, dtype_code, nd = struct.unpack(">HBB", f.read(4))
-            if zero != 0 or dtype_code != 0x08 or nd != ndim:
+            from .fetchers import read_idx_header
+            dtype_code, dims = read_idx_header(f)
+            if dtype_code != 0x08 or len(dims) != ndim:
                 raise IOError(f"{path}: not a u8 rank-{ndim} IDX file")
-            dims = struct.unpack(">" + "I" * nd, f.read(4 * nd))
             want = 1
             for d in dims:
                 want *= d

@@ -60,11 +60,19 @@ def read_idx_f32(path: Path, scale: float = 1.0) -> np.ndarray:
     return _read_idx_py(io.BytesIO(data)).astype(np.float32) * scale
 
 
-def _read_idx_py(f) -> np.ndarray:
+def read_idx_header(f):
+    """Parse an IDX header from a binary stream: (dtype_code, dims).
+    Shared by the readers here and the download validator
+    (datasets/downloader._verify_idx)."""
     zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
     if zero != 0:
         raise ValueError("bad IDX magic")
     dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+    return dtype_code, dims
+
+
+def _read_idx_py(f) -> np.ndarray:
+    dtype_code, dims = read_idx_header(f)
     dtype = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
              0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}[dtype_code]
     data = np.frombuffer(f.read(), dtype=np.dtype(dtype).newbyteorder(">"))

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from .base import LayerImpl, register_impl
 from .. import weights as winit
-from ...parallel.ring import full_attention
+from ...ops import helpers as ophelpers
 
 Array = jax.Array
 
@@ -49,7 +49,7 @@ class SelfAttentionLayerImpl(LayerImpl):
         q = split(jnp.einsum("btf,fo->bto", x, params["Wq"]))
         k = split(jnp.einsum("btf,fo->bto", x, params["Wk"]))
         v = split(jnp.einsum("btf,fo->bto", x, params["Wv"]))
-        o = full_attention(q, k, v, causal=conf.causal)
+        o = ophelpers.attention(q, k, v, causal=conf.causal)
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
         out = jnp.einsum("btm,mn->btn", o.reshape(B, T, conf.n_out),

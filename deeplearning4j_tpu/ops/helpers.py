@@ -183,3 +183,22 @@ def _lrn_default(x: Array, *, k, n, alpha, beta) -> Array:
 def lrn(x: Array, *, k=2.0, n=5.0, alpha=1e-4, beta=0.75) -> Array:
     impl = _HELPERS.get("lrn", _lrn_default)
     return impl(x, k=k, n=n, alpha=alpha, beta=beta)
+
+
+# -- multi-head attention -----------------------------------------------------
+
+def _attention_default(q: Array, k: Array, v: Array, *, causal=False,
+                       scale=None) -> Array:
+    """Dense attention via XLA einsums (parallel/ring.full_attention)."""
+    from ..parallel.ring import full_attention
+    return full_attention(q, k, v, causal=causal, scale=scale)
+
+
+def attention(q: Array, k: Array, v: Array, *, causal: bool = False,
+              scale=None) -> Array:
+    """Multi-head attention helper seam. q,k,v: [B, L, H, D] -> [B, L, H, D].
+    The accelerated plugin may register a flash-attention kernel here
+    (ops/pallas_kernels.py), same silent-fallback semantics as the conv/
+    LSTM helpers."""
+    impl = _HELPERS.get("attention", _attention_default)
+    return impl(q, k, v, causal=causal, scale=scale)

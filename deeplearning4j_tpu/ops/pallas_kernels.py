@@ -317,13 +317,47 @@ _AUTOTUNE_ITERS = 30
 
 
 def autotune_decisions() -> Dict:
-    """Snapshot of the per-shape kernel-vs-XLA decisions made so far:
-    {(T, B, H, dtype, activation, reverse): kernel_selected}."""
-    return dict(_AUTOTUNE_CACHE)
+    """Snapshot of ALL per-shape kernel-vs-XLA decisions made so far,
+    keyed ("lstm", ...shape key...) / ("attention", ...shape key...)."""
+    out = {("lstm",) + k: v for k, v in _AUTOTUNE_CACHE.items()}
+    out.update({("attention",) + k: v
+                for k, v in _ATTN_AUTOTUNE_CACHE.items()})
+    return out
 
 
 def clear_autotune_cache() -> None:
     _AUTOTUNE_CACHE.clear()
+    _ATTN_AUTOTUNE_CACHE.clear()
+
+
+def _measure_thunk(thunk) -> float:
+    """Time _AUTOTUNE_ITERS invocations with a full host-fetch sync on both
+    ends (block_until_ready can lie through the axon tunnel — see
+    .claude/skills/verify/SKILL.md)."""
+    import time
+    out = thunk()
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    _ = float(jnp.sum(leaf))
+    t0 = time.perf_counter()
+    for _i in range(_AUTOTUNE_ITERS):
+        out = thunk()
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    _ = float(jnp.sum(leaf))
+    return time.perf_counter() - t0
+
+
+def _empirical_gate(new_fwd, new_train, ref_fwd, ref_train) -> bool:
+    """Shared decision rule: the candidate kernel must beat the reference
+    on BOTH forward and fwd+bwd cost with a 0.95 anti-flap margin; any
+    failure to run counts as unsupported (False)."""
+    try:
+        t_n_f = _measure_thunk(new_fwd)
+        t_n_t = _measure_thunk(new_train)
+    except Exception:
+        return False
+    t_r_f = _measure_thunk(ref_fwd)
+    t_r_t = _measure_thunk(ref_train)
+    return (t_n_f < t_r_f * 0.95) and (t_n_t < t_r_t * 0.95)
 
 
 def _autotune_lstm(T, B, H, dtype, activation, reverse) -> bool:
@@ -333,7 +367,6 @@ def _autotune_lstm(T, B, H, dtype, activation, reverse) -> bool:
     from stale measurements and lost its own benchmark (VERDICT r2 weak #3);
     the only defensible gate on a noisy tunnel-attached chip is measuring.
     Runs EAGERLY at first trace of a shape; the decision is cached."""
-    import time
     import numpy as np
     rng = np.random.default_rng(0)
     xp = jnp.asarray(rng.normal(size=(T, B, 4 * H)), dtype)
@@ -366,26 +399,8 @@ def _autotune_lstm(T, B, H, dtype, activation, reverse) -> bool:
         j = jax.jit(lambda *a: fn(*a)[0])
         return lambda: j(*args)
 
-    def measure(thunk):
-        out = thunk()
-        leaf = jax.tree_util.tree_leaves(out)[0]
-        _ = float(jnp.sum(leaf))    # full sync (block_until_ready can lie
-        t0 = time.perf_counter()    # through the axon tunnel)
-        for _i in range(_AUTOTUNE_ITERS):
-            out = thunk()
-        leaf = jax.tree_util.tree_leaves(out)[0]
-        _ = float(jnp.sum(leaf))
-        return time.perf_counter() - t0
-
-    try:
-        t_pal_f = measure(fwd_only(pal_vjp))
-        t_pal_t = measure(train_like(pal_vjp))
-    except Exception:
-        return False  # kernel unsupported on this shape/backend
-    t_xla_f = measure(fwd_only(ref))
-    t_xla_t = measure(train_like(ref))
-    # 0.95 margin against flapping on measurement noise
-    return (t_pal_f < t_xla_f * 0.95) and (t_pal_t < t_xla_t * 0.95)
+    return _empirical_gate(fwd_only(pal_vjp), train_like(pal_vjp),
+                           fwd_only(ref), train_like(ref))
 
 
 def lstm_sequence_pallas(xproj_t, rw, peep, h0, c0, *, activation, reverse):
@@ -412,6 +427,80 @@ def lstm_sequence_pallas(xproj_t, rw, peep, h0, c0, *, activation, reverse):
 
 
 # =============================================================================
+# flash attention (library Pallas kernel behind the helper seam)
+# =============================================================================
+
+_ATTN_AUTOTUNE_CACHE: Dict = {}
+
+
+def _flash_call(q, k, v, causal, scale):
+    """q,k,v: [B, L, H, D] (the framework layout) -> [B, L, H, D] via the
+    TPU flash-attention Pallas kernel (jax.experimental.pallas.ops.tpu),
+    which ships its own backward pass."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import \
+        flash_attention
+    D = q.shape[-1]
+    sm_scale = float(scale) if scale is not None else float(1.0 / (D ** 0.5))
+    qt = jnp.swapaxes(q, 1, 2)  # [B, H, L, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=sm_scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _autotune_attention(B, L, H, D, dtype, causal) -> bool:
+    """Measure flash vs the XLA einsum attention on this exact shape —
+    forward AND fwd+bwd (same empirical-gate policy as the LSTM kernel)."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)), dtype)
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)), dtype)
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)), dtype)
+
+    def ref(q, k, v):
+        return helpers._attention_default(q, k, v, causal=causal, scale=None)
+
+    def fla(q, k, v):
+        return _flash_call(q, k, v, causal, None)
+
+    def fwd(fn):
+        j = jax.jit(fn)
+        return lambda: j(q, k, v)
+
+    def train(fn):
+        g = jax.jit(jax.grad(
+            lambda args: jnp.sum(fn(*args).astype(jnp.float32))))
+        return lambda: g((q, k, v))
+
+    return _empirical_gate(fwd(fla), train(fla), fwd(ref), train(ref))
+
+
+def attention_pallas(q, k, v, *, causal=False, scale=None):
+    """Helper-seam attention: per-shape autotuned choice between the
+    library flash-attention Pallas kernel and the XLA einsum path.
+
+    Measured on this v5e the XLA path wins at every probed shape (e.g.
+    L=8192 bf16 D=128: XLA 5.9 ms fwd / 16.9 ms train vs flash 9.3 / 31.0)
+    — XLA's fused attention is strong on TPU and the library kernel's
+    default block sizes are not tuned for v5e-lite — so the autotuner
+    keeps XLA here. The seam stays: on hardware/shapes where the kernel
+    measures faster it is selected automatically, cuDNN-find-algorithm
+    style, with zero code changes."""
+    if _INTERPRET:  # CPU/test runs: the flash kernel is TPU-only
+        return helpers._attention_default(q, k, v, causal=causal,
+                                          scale=scale)
+    B, L, H, D = q.shape
+    key = (B, L, H, D, jnp.dtype(q.dtype).name, bool(causal))
+    if key not in _ATTN_AUTOTUNE_CACHE:
+        _ATTN_AUTOTUNE_CACHE[key] = _autotune_attention(
+            B, L, H, D, q.dtype, bool(causal))
+    if not _ATTN_AUTOTUNE_CACHE[key]:
+        return helpers._attention_default(q, k, v, causal=causal,
+                                          scale=scale)
+    return _flash_call(q, k, v, causal, scale)
+
+
+# =============================================================================
 # registration
 # =============================================================================
 
@@ -435,9 +524,11 @@ def enable(interpret=None, use_conv=None) -> None:
     if use_conv:
         helpers.register_helper("conv2d_bias_act", conv2d_bias_act_pallas)
     helpers.register_helper("lstm_sequence", lstm_sequence_pallas)
+    helpers.register_helper("attention", attention_pallas)
 
 
 def disable() -> None:
     """Restore the XLA default implementations (silent-fallback seam)."""
     helpers.register_helper("conv2d_bias_act", None)
     helpers.register_helper("lstm_sequence", None)
+    helpers.register_helper("attention", None)

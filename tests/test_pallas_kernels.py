@@ -145,3 +145,66 @@ def test_network_training_identical_with_helpers_on(pallas_on):
                           use_conv=True)
     np.testing.assert_allclose(net_on.params_flat(), net_off.params_flat(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_attention_helper_seam_dispatch():
+    """The attention seam routes through registered helpers and falls back
+    to the XLA path in interpreter (CPU) runs; a custom registration is
+    honored and disable() restores the default."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops import helpers, pallas_kernels
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 8, 2, 4)), jnp.float32)
+
+    base = helpers.attention(q, q, q, causal=True)
+    pallas_kernels.enable()  # interpret on CPU: attention falls back to XLA
+    try:
+        via_seam = helpers.attention(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(via_seam),
+                                   atol=1e-6)
+    finally:
+        pallas_kernels.disable()
+
+    calls = []
+
+    def fake(qq, kk, vv, *, causal, scale):
+        calls.append(causal)
+        return helpers._attention_default(qq, kk, vv, causal=causal,
+                                          scale=scale)
+
+    helpers.register_helper("attention", fake)
+    try:
+        helpers.attention(q, q, q, causal=True)
+        assert calls == [True]
+    finally:
+        helpers.register_helper("attention", None)
+
+
+def test_attention_layer_uses_seam():
+    """SelfAttentionLayer forwards through the helper seam (so a flash
+    registration accelerates it with no layer changes)."""
+    from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.base import impl_for
+    from deeplearning4j_tpu.ops import helpers
+    import jax
+
+    conf = SelfAttentionLayer(n_in=4, n_out=8, n_heads=2, causal=True,
+                              activation="identity")
+    impl = impl_for(conf)
+    params = impl.init_params(jax.random.PRNGKey(0))
+    x = np.random.default_rng(1).normal(size=(2, 6, 4)).astype(np.float32)
+
+    seen = []
+
+    def spy(q, k, v, *, causal, scale):
+        seen.append(q.shape)
+        return helpers._attention_default(q, k, v, causal=causal,
+                                          scale=scale)
+
+    helpers.register_helper("attention", spy)
+    try:
+        impl.forward(params, x)
+        assert seen and seen[0] == (2, 6, 2, 4)
+    finally:
+        helpers.register_helper("attention", None)
