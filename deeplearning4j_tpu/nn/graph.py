@@ -722,6 +722,18 @@ class ComputationGraph:
             ev.eval(ds.labels, out, mask=getattr(ds, "labels_mask", None))
         return ev
 
+    def evaluate_regression(self, iterator):
+        """Per-column regression metrics (reference
+        ComputationGraph.evaluateRegression; single-input/single-output)."""
+        from ..evaluation.evaluation import RegressionEvaluation
+        ev = RegressionEvaluation()
+        for ds in iterator:
+            fm = getattr(ds, "features_mask", None)
+            out = np.asarray(self.output(
+                ds.features, fmasks=[fm] if fm is not None else None)[0])
+            ev.eval(ds.labels, out, mask=getattr(ds, "labels_mask", None))
+        return ev
+
     def clone(self) -> "ComputationGraph":
         g = ComputationGraph(copy.deepcopy(self.conf))
         if self._initialized:

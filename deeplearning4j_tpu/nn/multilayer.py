@@ -735,6 +735,17 @@ class MultiLayerNetwork:
             ev.eval(ds.labels, out, mask=getattr(ds, "labels_mask", None))
         return ev
 
+    def evaluate_regression(self, iterator):
+        """Per-column regression metrics over a dataset (reference
+        MultiLayerNetwork.evaluateRegression)."""
+        from ..evaluation.evaluation import RegressionEvaluation
+        ev = RegressionEvaluation()
+        for ds in iterator:
+            out = np.asarray(self.output(
+                ds.features, fmask=getattr(ds, "features_mask", None)))
+            ev.eval(ds.labels, out, mask=getattr(ds, "labels_mask", None))
+        return ev
+
     def summary(self) -> str:
         lines = ["=" * 70]
         for i, lc in enumerate(self.conf.layers):

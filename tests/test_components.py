@@ -197,3 +197,46 @@ def test_image_record_reader_npy(tmp_path):
     assert ds.features.shape == (6, 16)
     assert ds.labels.shape == (6, 2)
     assert ds.labels.sum() == 6
+
+
+def test_evaluate_regression_facades():
+    """evaluate_regression on both facades (reference evaluateRegression)."""
+    import numpy as np
+    from deeplearning4j_tpu import (DataSet, ListDataSetIterator,
+                                    MultiLayerNetwork, NeuralNetConfiguration,
+                                    Sgd)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    w = np.asarray([[1.0, -0.5], [0.3, 0.8], [-0.2, 0.1]], np.float32)
+    y = x @ w + 0.01 * rng.normal(size=(64, 2)).astype(np.float32)
+
+    conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.05)
+            .updater(Sgd()).list()
+            .layer(DenseLayer(n_in=3, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="identity",
+                               loss="mse"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(150):
+        net.fit_batch(x, y)
+    ev = net.evaluate_regression(ListDataSetIterator(DataSet(x, y), batch=16))
+    assert ev.n_columns == 2
+    assert all(ev.mean_squared_error(c) < 0.05 for c in range(2))
+    assert all(ev.r_squared(c) > 0.8 for c in range(2))
+
+    gconf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.05)
+             .updater(Sgd()).graph_builder().add_inputs("in")
+             .add_layer("h", DenseLayer(n_in=3, n_out=8, activation="tanh"),
+                        "in")
+             .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                           activation="identity",
+                                           loss="mse"), "h")
+             .set_outputs("out").build())
+    g = ComputationGraph(gconf).init()
+    for _ in range(150):
+        g.fit(x, y)
+    gev = g.evaluate_regression(ListDataSetIterator(DataSet(x, y), batch=16))
+    assert all(gev.mean_squared_error(c) < 0.05 for c in range(2))
