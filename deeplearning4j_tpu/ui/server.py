@@ -29,6 +29,8 @@ _DASHBOARD = """<!DOCTYPE html>
 <html><head><title>dl4j-tpu training UI</title></head>
 <body style="font-family:sans-serif">
 <h2>dl4j-tpu training UI</h2>
+<p><a href="/tsne">t-SNE view</a> | <a href="/nearestneighbors">nearest
+neighbors</a></p>
 <div id="sessions"></div>
 <canvas id="chart" width="900" height="320" style="border:1px solid #ccc"></canvas>
 <script>

@@ -1,0 +1,97 @@
+"""Disk-backed FIFO queue (reference util/DiskBasedQueue.java: spill a
+work queue to disk so producers outpacing consumers don't exhaust memory).
+
+Segmented design instead of the reference's file-per-element: elements are
+pickled into append-only segment files of `segment_size` items; the reader
+streams segments in order and deletes each one when drained. Single-process
+safe (one lock); crash leaves at most the current segments on disk, which a
+new instance over the same directory resumes from.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+
+class DiskBasedQueue:
+    def __init__(self, directory, segment_size: int = 1024):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.segment_size = max(1, segment_size)
+        self._lock = threading.Lock()
+        # resume: existing segments (sorted) count as pending
+        self._segments = sorted(
+            int(p.stem.split("-")[1]) for p in self.dir.glob("seg-*.pkl"))
+        self._next_seg = (self._segments[-1] + 1) if self._segments else 0
+        self._write_buf: list = []
+        self._read_buf: list = []
+
+    def _seg_path(self, n: int) -> Path:
+        return self.dir / f"seg-{n:08d}.pkl"
+
+    def add(self, item: Any) -> None:
+        with self._lock:
+            self._write_buf.append(item)
+            if len(self._write_buf) >= self.segment_size:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._write_buf:
+            return
+        path = self._seg_path(self._next_seg)
+        tmp = path.with_name(f".{path.name}.tmp")
+        with open(tmp, "wb") as fh:
+            pickle.dump(self._write_buf, fh)
+        os.replace(tmp, path)
+        self._segments.append(self._next_seg)
+        self._next_seg += 1
+        self._write_buf = []
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def poll(self) -> Optional[Any]:
+        """Pop the oldest element, or None when empty."""
+        with self._lock:
+            if not self._read_buf:
+                if self._segments:
+                    n = self._segments.pop(0)
+                    with open(self._seg_path(n), "rb") as fh:
+                        self._read_buf = pickle.load(fh)
+                    self._seg_path(n).unlink(missing_ok=True)
+                elif self._write_buf:  # drain the unflushed tail
+                    self._read_buf = self._write_buf
+                    self._write_buf = []
+            if self._read_buf:
+                return self._read_buf.pop(0)
+            return None
+
+    def __len__(self) -> int:
+        with self._lock:
+            on_disk = 0
+            for n in self._segments:
+                try:
+                    with open(self._seg_path(n), "rb") as fh:
+                        on_disk += len(pickle.load(fh))
+                except OSError:
+                    pass
+            return on_disk + len(self._write_buf) + len(self._read_buf)
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            item = self.poll()
+            if item is None:
+                return
+            yield item
+
+    def clear(self) -> None:
+        with self._lock:
+            for n in self._segments:
+                self._seg_path(n).unlink(missing_ok=True)
+            self._segments = []
+            self._write_buf = []
+            self._read_buf = []
