@@ -433,10 +433,23 @@ def lstm_sequence_pallas(xproj_t, rw, peep, h0, c0, *, activation, reverse):
 _ATTN_AUTOTUNE_CACHE: Dict = {}
 
 
-def _flash_call(q, k, v, causal, scale):
+def _flash_block_sizes(block: int):
+    """Square BlockSizes config for fwd AND both backward kernels."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+    b = block
+    return BlockSizes(block_q=b, block_k_major=b, block_k=b, block_b=1,
+                      block_q_major_dkv=b, block_k_major_dkv=b,
+                      block_k_dkv=b, block_q_dkv=b,
+                      block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
+
+
+def _flash_call(q, k, v, causal, scale, block: int = 0):
     """q,k,v: [B, L, H, D] (the framework layout) -> [B, L, H, D] via the
     TPU flash-attention Pallas kernel (jax.experimental.pallas.ops.tpu),
-    which ships its own backward pass."""
+    which ships its own backward pass. block=0 uses the library default
+    BlockSizes; nonzero uses a square config (the autotuner probes these —
+    measured on v5e the defaults are badly mistuned: L=8192 bf16 runs
+    11.4 ms default vs 2.95 ms at block 1024 vs 5.9 ms XLA)."""
     from jax.experimental.pallas.ops.tpu.flash_attention import \
         flash_attention
     D = q.shape[-1]
@@ -444,24 +457,22 @@ def _flash_call(q, k, v, causal, scale):
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, L, D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=sm_scale)
+    bs = _flash_block_sizes(block) if block else None
+    out = flash_attention(qt, kt, vt, causal=causal, sm_scale=sm_scale,
+                          block_sizes=bs)
     return jnp.swapaxes(out, 1, 2)
 
 
-def _autotune_attention(B, L, H, D, dtype, causal) -> bool:
-    """Measure flash vs the XLA einsum attention on this exact shape —
-    forward AND fwd+bwd (same empirical-gate policy as the LSTM kernel)."""
+def _autotune_attention(B, L, H, D, dtype, causal):
+    """Probe the flash kernel (library-default blocks plus square block
+    candidates that divide L) against the XLA einsum attention on this
+    exact shape — forward AND fwd+bwd. Returns the winning flash block
+    config (int; 0 = library default) or False for the XLA path."""
     import numpy as np
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.normal(size=(B, L, H, D)), dtype)
     k = jnp.asarray(rng.normal(size=(B, L, H, D)), dtype)
     v = jnp.asarray(rng.normal(size=(B, L, H, D)), dtype)
-
-    def ref(q, k, v):
-        return helpers._attention_default(q, k, v, causal=causal, scale=None)
-
-    def fla(q, k, v):
-        return _flash_call(q, k, v, causal, None)
 
     def fwd(fn):
         j = jax.jit(fn)
@@ -472,20 +483,42 @@ def _autotune_attention(B, L, H, D, dtype, causal) -> bool:
             lambda args: jnp.sum(fn(*args).astype(jnp.float32))))
         return lambda: g((q, k, v))
 
-    return _empirical_gate(fwd(fla), train(fla), fwd(ref), train(ref))
+    def ref(q, k, v):
+        return helpers._attention_default(q, k, v, causal=causal, scale=None)
+
+    candidates = [0] + [b for b in (512, 1024) if L % b == 0]
+    best = None  # (fwd_time, train_time, block)
+    for block in candidates:
+        def fla(q, k, v, block=block):
+            return _flash_call(q, k, v, causal, None, block=block)
+        try:
+            t_f = _measure_thunk(fwd(fla))
+            t_t = _measure_thunk(train(fla))
+        except Exception:
+            continue  # unsupported config for this shape
+        if best is None or t_f + t_t < best[0] + best[1]:
+            best = (t_f, t_t, block)
+    if best is None:
+        return False
+    # compare the recorded winner timings against XLA (no re-measurement of
+    # the winner); same both-metrics 0.95 margin as _empirical_gate
+    t_r_f = _measure_thunk(fwd(ref))
+    t_r_t = _measure_thunk(train(ref))
+    if best[0] < t_r_f * 0.95 and best[1] < t_r_t * 0.95:
+        return best[2]
+    return False
 
 
 def attention_pallas(q, k, v, *, causal=False, scale=None):
-    """Helper-seam attention: per-shape autotuned choice between the
-    library flash-attention Pallas kernel and the XLA einsum path.
+    """Helper-seam attention: per-shape autotuned choice among the XLA
+    einsum path and the flash-attention Pallas kernel under several block
+    configurations (cuDNN find-algorithm semantics).
 
-    Measured on this v5e the XLA path wins at every probed shape (e.g.
-    L=8192 bf16 D=128: XLA 5.9 ms fwd / 16.9 ms train vs flash 9.3 / 31.0)
-    — XLA's fused attention is strong on TPU and the library kernel's
-    default block sizes are not tuned for v5e-lite — so the autotuner
-    keeps XLA here. The seam stays: on hardware/shapes where the kernel
-    measures faster it is selected automatically, cuDNN-find-algorithm
-    style, with zero code changes."""
+    Block tuning is decisive on v5e: at L=8192 bf16 D=128 the kernel runs
+    11.4 ms fwd with library-default blocks (losing to XLA's 5.9 ms) but
+    2.95 ms with square 1024 blocks — 2x FASTER than XLA. Short sequences
+    keep the XLA path; long-context shapes select the tuned kernel
+    automatically at first trace."""
     if _INTERPRET:  # CPU/test runs: the flash kernel is TPU-only
         return helpers._attention_default(q, k, v, causal=causal,
                                           scale=scale)
@@ -494,10 +527,11 @@ def attention_pallas(q, k, v, *, causal=False, scale=None):
     if key not in _ATTN_AUTOTUNE_CACHE:
         _ATTN_AUTOTUNE_CACHE[key] = _autotune_attention(
             B, L, H, D, q.dtype, bool(causal))
-    if not _ATTN_AUTOTUNE_CACHE[key]:
+    decision = _ATTN_AUTOTUNE_CACHE[key]
+    if decision is False:
         return helpers._attention_default(q, k, v, causal=causal,
                                           scale=scale)
-    return _flash_call(q, k, v, causal, scale)
+    return _flash_call(q, k, v, causal, scale, block=int(decision))
 
 
 # =============================================================================

@@ -28,6 +28,15 @@ class DiskBasedQueue:
         self._next_seg = (self._segments[-1] + 1) if self._segments else 0
         self._write_buf: list = []
         self._read_buf: list = []
+        # per-segment item counts so len() is O(#segments), not O(items);
+        # resumed segments are counted once here
+        self._seg_counts = {}
+        for n in self._segments:
+            try:
+                with open(self._seg_path(n), "rb") as fh:
+                    self._seg_counts[n] = len(pickle.load(fh))
+            except OSError:
+                self._seg_counts[n] = 0
 
     def _seg_path(self, n: int) -> Path:
         return self.dir / f"seg-{n:08d}.pkl"
@@ -47,6 +56,7 @@ class DiskBasedQueue:
             pickle.dump(self._write_buf, fh)
         os.replace(tmp, path)
         self._segments.append(self._next_seg)
+        self._seg_counts[self._next_seg] = len(self._write_buf)
         self._next_seg += 1
         self._write_buf = []
 
@@ -54,12 +64,13 @@ class DiskBasedQueue:
         with self._lock:
             self._flush_locked()
 
-    def poll(self) -> Optional[Any]:
-        """Pop the oldest element, or None when empty."""
+    def _pop(self):
+        """(found, item) — unambiguous even for enqueued None values."""
         with self._lock:
             if not self._read_buf:
                 if self._segments:
                     n = self._segments.pop(0)
+                    self._seg_counts.pop(n, None)
                     with open(self._seg_path(n), "rb") as fh:
                         self._read_buf = pickle.load(fh)
                     self._seg_path(n).unlink(missing_ok=True)
@@ -67,24 +78,24 @@ class DiskBasedQueue:
                     self._read_buf = self._write_buf
                     self._write_buf = []
             if self._read_buf:
-                return self._read_buf.pop(0)
-            return None
+                return True, self._read_buf.pop(0)
+            return False, None
+
+    def poll(self) -> Optional[Any]:
+        """Pop the oldest element, or None when empty (Java Queue.poll
+        semantics, like the reference; use __iter__/_pop when enqueued
+        None values must be distinguishable from emptiness)."""
+        return self._pop()[1]
 
     def __len__(self) -> int:
         with self._lock:
-            on_disk = 0
-            for n in self._segments:
-                try:
-                    with open(self._seg_path(n), "rb") as fh:
-                        on_disk += len(pickle.load(fh))
-                except OSError:
-                    pass
-            return on_disk + len(self._write_buf) + len(self._read_buf)
+            return (sum(self._seg_counts.get(n, 0) for n in self._segments)
+                    + len(self._write_buf) + len(self._read_buf))
 
     def __iter__(self) -> Iterator[Any]:
         while True:
-            item = self.poll()
-            if item is None:
+            found, item = self._pop()
+            if not found:
                 return
             yield item
 
@@ -93,5 +104,6 @@ class DiskBasedQueue:
             for n in self._segments:
                 self._seg_path(n).unlink(missing_ok=True)
             self._segments = []
+            self._seg_counts = {}
             self._write_buf = []
             self._read_buf = []

@@ -222,3 +222,14 @@ def test_disk_based_queue(tmp_path):
     del q2
     q3 = DiskBasedQueue(tmp_path / "q2", segment_size=2)
     assert list(q3) == [0, 1, 2, 3, 4]
+
+
+def test_disk_queue_none_values_and_len(tmp_path):
+    from deeplearning4j_tpu.util.diskqueue import DiskBasedQueue
+    q = DiskBasedQueue(tmp_path / "qn", segment_size=2)
+    q.add(None)
+    q.add(1)
+    q.add(None)
+    assert len(q) == 3
+    assert list(q) == [None, 1, None]  # None elements survive iteration
+    assert len(q) == 0
