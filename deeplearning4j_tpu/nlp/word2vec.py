@@ -198,28 +198,49 @@ class SequenceVectors:
 
         return step
 
+    def _ensure_scan_state(self):
+        """Create the scan program + its device-side state together —
+        the training loop and the warmup both enter here, so the scan path
+        can never run with partial state."""
+        if not hasattr(self, "_scan_step"):
+            self._scan_step = self._make_neg_scan_step()
+            self._neg_table_dev = jnp.asarray(self._neg_table)
+            self._scan_key = jax.random.PRNGKey(self.seed + 1)
+            self._chunk_counter = 0
+
     def _make_neg_scan_step(self):
         """K skip-gram/negative batches per device dispatch via lax.scan —
-        the per-batch host->device transfers (6 small arrays each) dominate
-        wall time on a tunnel-attached chip, so the epoch's pair stream is
-        uploaded in large stacked chunks and stepped device-resident (the
-        same design as MultiLayerNetwork.fit_scan)."""
+        the per-batch host->device transfers dominate wall time on a
+        tunnel-attached chip, so the epoch's pair stream is uploaded in
+        large stacked chunks and stepped device-resident (the same design
+        as MultiLayerNetwork.fit_scan). Negatives are sampled ON DEVICE
+        from the unigram table (uploaded once) — they were the bulk of the
+        per-chunk upload."""
         clip = self.grad_clip
+        K = self.negative
 
         @partial(jax.jit, donate_argnums=(0, 1))
-        def scan_step(syn0, syn1neg, centers, contexts, negss, valids, lrs):
+        def scan_step(syn0, syn1neg, neg_table, rng_key, centers, contexts,
+                      valids, lrs):
+            tbl_size = neg_table.shape[0]
+
             def body(carry, inp):
-                s0, s1 = carry
-                c, t, n, v, lr = inp
+                s0, s1, i = carry
+                c, t, v, lr = inp
+                draw = jax.random.randint(
+                    jax.random.fold_in(rng_key, i), (c.shape[0], K), 0,
+                    tbl_size)
+                n = neg_table[draw]
                 loss, (g0, g1) = jax.value_and_grad(
                     _neg_sampling_loss, argnums=(0, 1))(s0, s1, c, t, n, v)
                 g0 = jnp.clip(g0, -clip, clip)
                 g1 = jnp.clip(g1, -clip, clip)
-                return (s0 - lr * g0, s1 - lr * g1), \
+                return (s0 - lr * g0, s1 - lr * g1, i + 1), \
                     loss / jnp.maximum(jnp.sum(v), 1.0)
 
-            (syn0, syn1neg), losses = jax.lax.scan(
-                body, (syn0, syn1neg), (centers, contexts, negss, valids, lrs))
+            (syn0, syn1neg, _), losses = jax.lax.scan(
+                body, (syn0, syn1neg, jnp.asarray(0)),
+                (centers, contexts, valids, lrs))
             return syn0, syn1neg, losses
 
         return scan_step
@@ -407,16 +428,16 @@ class SequenceVectors:
                     >= self.SCAN_BATCHES * B):
                 # warm the multi-batch scan program too (only when an epoch
                 # can actually reach it); zero-valid batches make it a
-                # no-op update (outputs reassigned: it donates)
-                if not hasattr(self, "_scan_step"):
-                    self._scan_step = self._make_neg_scan_step()
+                # no-op update (outputs reassigned: it donates). The
+                # unigram table uploads ONCE here for on-device sampling.
+                self._ensure_scan_state()
                 sn = self.SCAN_BATCHES
                 zc = jnp.zeros((sn, B), jnp.int32)
-                zn = jnp.zeros((sn, B, self.negative), jnp.int32)
                 zvv = jnp.zeros((sn, B), jnp.float32)
                 zl = jnp.zeros((sn,), jnp.float32)
                 table.syn0, table.syn1neg, _ = self._scan_step(
-                    table.syn0, table.syn1neg, zc, zc, zn, zvv, zl)
+                    table.syn0, table.syn1neg, self._neg_table_dev,
+                    jax.random.PRNGKey(0), zc, zc, zvv, zl)
         if step_hs is not None and not self.cbow:
             Pmax = max(self._max_code_len, 1)
             zp = jnp.zeros((B, Pmax), jnp.int32)
@@ -486,16 +507,20 @@ class SequenceVectors:
             scan_n = self.SCAN_BATCHES
             if (self.negative > 0 and not self.use_hs and self.mesh is None
                     and centers.size >= scan_n * B):
-                if not hasattr(self, "_scan_step"):
-                    self._scan_step = self._make_neg_scan_step()
+                self._ensure_scan_state()
                 chunk_pairs = scan_n * B
-                n_chunks = centers.size // chunk_pairs
+                # the TAIL also rides the scan: pad it to a full chunk with
+                # zero-valid rows so no per-batch tunnel transfers remain
+                n_chunks = -(-centers.size // chunk_pairs)
                 for ci in range(n_chunks):
                     lo = ci * chunk_pairs
-                    cs = centers[lo:lo + chunk_pairs].reshape(scan_n, B)
-                    ts = contexts[lo:lo + chunk_pairs].reshape(scan_n, B)
-                    ns = self._sample_negatives(rng,
-                                                (scan_n, B, self.negative))
+                    real = min(chunk_pairs, centers.size - lo)
+                    cs = np.zeros(chunk_pairs, np.int32)
+                    ts = np.zeros(chunk_pairs, np.int32)
+                    cs[:real] = centers[lo:lo + real]
+                    ts[:real] = contexts[lo:lo + real]
+                    cs = cs.reshape(scan_n, B)
+                    ts = ts.reshape(scan_n, B)
                     # per-batch linear lr decay inside the chunk
                     seen_at = seen + np.arange(scan_n, dtype=np.float64) * B
                     lrs = np.maximum(
@@ -503,13 +528,18 @@ class SequenceVectors:
                         self.learning_rate
                         * (1.0 - np.minimum(1.0, seen_at / total_pairs))
                     ).astype(np.float32)
-                    valids = np.ones((scan_n, B), np.float32)
+                    valids = np.zeros(chunk_pairs, np.float32)
+                    valids[:real] = 1.0
+                    valids = valids.reshape(scan_n, B)
+                    self._chunk_counter += 1
+                    chunk_key = jax.random.fold_in(
+                        self._scan_key, self._chunk_counter & 0x7FFFFFFF)
                     table.syn0, table.syn1neg, losses = self._scan_step(
-                        table.syn0, table.syn1neg, jnp.asarray(cs),
-                        jnp.asarray(ts), jnp.asarray(ns),
+                        table.syn0, table.syn1neg, self._neg_table_dev,
+                        chunk_key, jnp.asarray(cs), jnp.asarray(ts),
                         jnp.asarray(valids), jnp.asarray(lrs))
-                    last_loss = losses[-1]
-                    seen += chunk_pairs
+                    last_loss = losses[(real - 1) // B]
+                    seen += real
                 off0 = n_chunks * chunk_pairs
             for off in range(off0, centers.size, B):
                 c = centers[off:off + B]
@@ -535,10 +565,13 @@ class SequenceVectors:
                         put_b(mask_tbl[t]), put_b(valid), lr)
                 last_loss = loss
                 seen += nvalid
-        jax.block_until_ready(table.syn0)
+        # sync via a HOST FETCH before reading the clock: block_until_ready
+        # can return at enqueue time through a tunneled TPU (see
+        # .claude/skills/verify/SKILL.md), which would inflate words/sec
+        self.score_ = float(last_loss) if not isinstance(last_loss, float) \
+            else last_loss
         elapsed = max(_time.perf_counter() - t0, 1e-9)
         self.words_per_sec_ = tokens_seen / elapsed
-        self.score_ = float(last_loss)
         return self
 
     # -- query API (reference wordVectors interface) ---------------------------

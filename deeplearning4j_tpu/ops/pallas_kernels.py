@@ -348,7 +348,9 @@ def _measure_thunk(thunk) -> float:
 
 def _empirical_gate(new_fwd, new_train, ref_fwd, ref_train) -> bool:
     """Shared decision rule: the candidate kernel must beat the reference
-    on BOTH forward and fwd+bwd cost with a 0.95 anti-flap margin; any
+    on TOTAL (forward + fwd+bwd) cost with a 0.95 anti-flap margin, and
+    must not be more than 1.5x worse on either metric alone (a large win
+    on one side shouldn't buy a pathological loss on the other); any
     failure to run counts as unsupported (False)."""
     try:
         t_n_f = _measure_thunk(new_fwd)
@@ -357,7 +359,8 @@ def _empirical_gate(new_fwd, new_train, ref_fwd, ref_train) -> bool:
         return False
     t_r_f = _measure_thunk(ref_fwd)
     t_r_t = _measure_thunk(ref_train)
-    return (t_n_f < t_r_f * 0.95) and (t_n_t < t_r_t * 0.95)
+    return ((t_n_f + t_n_t) < (t_r_f + t_r_t) * 0.95
+            and t_n_f < t_r_f * 1.5 and t_n_t < t_r_t * 1.5)
 
 
 def _autotune_lstm(T, B, H, dtype, activation, reverse) -> bool:
@@ -501,10 +504,11 @@ def _autotune_attention(B, L, H, D, dtype, causal):
     if best is None:
         return False
     # compare the recorded winner timings against XLA (no re-measurement of
-    # the winner); same both-metrics 0.95 margin as _empirical_gate
+    # the winner); same total-cost rule as _empirical_gate
     t_r_f = _measure_thunk(fwd(ref))
     t_r_t = _measure_thunk(train(ref))
-    if best[0] < t_r_f * 0.95 and best[1] < t_r_t * 0.95:
+    if ((best[0] + best[1]) < (t_r_f + t_r_t) * 0.95
+            and best[0] < t_r_f * 1.5 and best[1] < t_r_t * 1.5):
         return best[2]
     return False
 

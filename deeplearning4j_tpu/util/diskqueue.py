@@ -28,15 +28,10 @@ class DiskBasedQueue:
         self._next_seg = (self._segments[-1] + 1) if self._segments else 0
         self._write_buf: list = []
         self._read_buf: list = []
-        # per-segment item counts so len() is O(#segments), not O(items);
-        # resumed segments are counted once here
+        # per-segment item counts so len() is O(#segments) after the first
+        # call; resumed segments are counted LAZILY (construction must not
+        # deserialize the whole backlog)
         self._seg_counts = {}
-        for n in self._segments:
-            try:
-                with open(self._seg_path(n), "rb") as fh:
-                    self._seg_counts[n] = len(pickle.load(fh))
-            except OSError:
-                self._seg_counts[n] = 0
 
     def _seg_path(self, n: int) -> Path:
         return self.dir / f"seg-{n:08d}.pkl"
@@ -89,8 +84,16 @@ class DiskBasedQueue:
 
     def __len__(self) -> int:
         with self._lock:
-            return (sum(self._seg_counts.get(n, 0) for n in self._segments)
-                    + len(self._write_buf) + len(self._read_buf))
+            total = len(self._write_buf) + len(self._read_buf)
+            for n in self._segments:
+                if n not in self._seg_counts:  # lazy count, cached
+                    try:
+                        with open(self._seg_path(n), "rb") as fh:
+                            self._seg_counts[n] = len(pickle.load(fh))
+                    except OSError:
+                        self._seg_counts[n] = 0
+                total += self._seg_counts[n]
+            return total
 
     def __iter__(self) -> Iterator[Any]:
         while True:
