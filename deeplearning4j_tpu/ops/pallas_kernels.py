@@ -313,7 +313,9 @@ def _get_lstm_fn(activation, reverse):
 
 
 _AUTOTUNE_CACHE: Dict = {}
-_AUTOTUNE_ITERS = 30
+# per-measurement iterations: probes ride the noisy tunnel (~±20% on short
+# runs), so spend enough device time that borderline decisions don't flap
+_AUTOTUNE_ITERS = 60
 
 
 def autotune_decisions() -> Dict:
