@@ -1,0 +1,43 @@
+"""Train the decoder-only transformer LM on a cyclic-token task and decode.
+
+Run: python examples/transformer_lm.py [--steps N]
+(On TPU with ops.pallas_kernels.enable(), long-context attention is
+block-autotuned onto the flash kernel automatically.)
+"""
+import argparse
+
+import numpy as np
+
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def main(steps: int = 80, vocab: int = 17, seq_len: int = 24,
+         batch: int = 16) -> float:
+    net = ComputationGraph(transformer_lm(vocab_size=vocab, d_model=64,
+                                          n_heads=4, n_blocks=2,
+                                          lr=1e-3)).init()
+    rng = np.random.default_rng(0)
+    for step in range(steps):
+        starts = rng.integers(0, vocab, batch)
+        ids = (starts[:, None] + np.arange(seq_len + 1)[None, :]) % vocab
+        x = np.eye(vocab, dtype=np.float32)[ids[:, :-1]]
+        y = np.eye(vocab, dtype=np.float32)[ids[:, 1:]]
+        net.fit([x], [y])
+        if (step + 1) % 20 == 0:
+            print(f"step {step + 1}: loss={net.score_:.4f}")
+
+    # greedy decode continues the learned cycle
+    seed = (np.arange(seq_len) % vocab)
+    x = np.eye(vocab, dtype=np.float32)[seed][None]
+    preds = np.asarray(net.output(x)[0])[0].argmax(-1)
+    expect = (seed + 1) % vocab
+    acc = float((preds == expect).mean())
+    print(f"next-token decode accuracy on the cycle: {acc:.2f}")
+    return acc
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=80)
+    main(p.parse_args().steps)

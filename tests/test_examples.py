@@ -32,3 +32,9 @@ def test_distributed_example():
 def test_serving_example():
     import model_serving
     assert model_serving.main() == 5
+
+
+def test_transformer_example():
+    import transformer_lm
+    acc = transformer_lm.main(steps=60, vocab=11, seq_len=12, batch=16)
+    assert acc > 0.8
