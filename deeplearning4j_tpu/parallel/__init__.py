@@ -1,0 +1,34 @@
+"""Distributed training: masters, meshes, sequence/pipeline/expert
+parallelism, fault tolerance, driver facades (SURVEY.md §2.4 analog).
+
+Submodules import lazily where heavy; the names below are the public
+surface a driver program uses.
+"""
+from .mesh import DATA_AXIS, default_mesh, make_mesh
+from .trainer import (IciDataParallelTrainingMaster, ParallelWrapper,
+                      ParameterAveragingTrainingMaster, TrainingMaster)
+from .statetracker import TrainingStateTracker, fit_with_recovery
+from .registry import ConfigurationRegistry
+from .pipeline import GPipeExecutor, stack_block_params
+from .moe import MoEExecutor
+from .spark_api import SparkComputationGraph, SparkDl4jMultiLayer
+from .evaluation import (DistributedDataSetLossCalculator,
+                         DistributedEarlyStoppingTrainer,
+                         distributed_evaluate, distributed_score)
+from .ring import full_attention, ring_attention, ulysses_attention
+from .stats import (NTPTimeSource, SparkTrainingStats, SystemClockTimeSource,
+                    TimeSource, device_trace, phase_timer)
+
+__all__ = [
+    "DATA_AXIS", "default_mesh", "make_mesh",
+    "TrainingMaster", "IciDataParallelTrainingMaster",
+    "ParameterAveragingTrainingMaster", "ParallelWrapper",
+    "TrainingStateTracker", "fit_with_recovery", "ConfigurationRegistry",
+    "GPipeExecutor", "stack_block_params", "MoEExecutor",
+    "SparkDl4jMultiLayer", "SparkComputationGraph",
+    "distributed_evaluate", "distributed_score",
+    "DistributedDataSetLossCalculator", "DistributedEarlyStoppingTrainer",
+    "full_attention", "ring_attention", "ulysses_attention",
+    "SparkTrainingStats", "TimeSource", "SystemClockTimeSource",
+    "NTPTimeSource", "phase_timer", "device_trace",
+]
