@@ -1,0 +1,5 @@
+"""Clustering + spatial structures (SURVEY §2.2: kmeans, kd/vp/sp/quad trees)."""
+from .kmeans import KMeansClustering
+from .trees import KDTree, QuadTree, SpTree, VPTree
+
+__all__ = ["KMeansClustering", "KDTree", "VPTree", "SpTree", "QuadTree"]

@@ -1,8 +1,8 @@
 """Distributed training: masters, meshes, sequence/pipeline/expert
 parallelism, fault tolerance, driver facades (SURVEY.md §2.4 analog).
 
-Submodules import lazily where heavy; the names below are the public
-surface a driver program uses.
+The names below are the public surface a driver program uses. Importing
+this package initializes jax (the submodules need it at import time).
 """
 from .mesh import DATA_AXIS, default_mesh, make_mesh
 from .trainer import (IciDataParallelTrainingMaster, ParallelWrapper,

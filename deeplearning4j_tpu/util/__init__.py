@@ -1,0 +1,19 @@
+"""Utilities: checkpoint serialization, gradient checking, matrix/sequence
+tools, telemetry, disk queue."""
+from .model_serializer import (load_model, restore_computation_graph,
+                               restore_multi_layer_network, save_model,
+                               write_model)
+from .gradientcheck import check_gradients
+from .matrixtools import (MovingWindowDataSetIterator, MovingWindowMatrix,
+                          Viterbi)
+from .diskqueue import DiskBasedQueue
+from .heartbeat import (disable_heartbeat, enable_heartbeat, report_event,
+                        set_sink)
+
+__all__ = [
+    "write_model", "save_model", "load_model",
+    "restore_multi_layer_network", "restore_computation_graph",
+    "check_gradients", "MovingWindowMatrix", "MovingWindowDataSetIterator",
+    "Viterbi", "DiskBasedQueue", "disable_heartbeat", "enable_heartbeat",
+    "report_event", "set_sink",
+]
