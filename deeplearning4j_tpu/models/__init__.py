@@ -1,0 +1,10 @@
+"""Model zoo: the reference benchmark configurations plus the long-context
+transformer this framework adds (see ``models/zoo.py``)."""
+from .zoo import (alexnet_cifar10, char_rnn_lstm, dbn_mnist,
+                  deep_autoencoder_mnist, lenet_mnist, mlp_iris,
+                  transformer_lm)
+
+__all__ = [
+    "alexnet_cifar10", "char_rnn_lstm", "dbn_mnist",
+    "deep_autoencoder_mnist", "lenet_mnist", "mlp_iris", "transformer_lm",
+]

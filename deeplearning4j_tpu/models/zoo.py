@@ -6,6 +6,8 @@ DSL a user would use.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 from ..nn.conf.config import MultiLayerConfiguration, NeuralNetConfiguration
 from ..nn.conf.inputs import InputType
 from ..nn.conf.layers import (BatchNormalization, ConvolutionLayer, DenseLayer,
@@ -95,6 +97,65 @@ def char_rnn_lstm(vocab_size: int = 77, hidden: int = 256, seed: int = 12345,
             .backprop_type(BACKPROP_TBPTT)
             .t_bptt_forward_length(tbptt).t_bptt_backward_length(tbptt)
             .build())
+
+
+def dbn_mnist(seed: int = 123, lr: float = 0.1, n_in: int = 784,
+              n_classes: int = 10,
+              hidden: tuple = (500, 250, 200)) -> MultiLayerConfiguration:
+    """Deep Belief Network: stacked RBMs + softmax output.
+
+    The reference's signature pretraining workload (stacked
+    nn/conf/layers/RBM.java hidden layers trained with CD-k via
+    nn/layers/feedforward/rbm/RBM.java:101 `contrastiveDivergence`, then
+    supervised finetuning through MultiLayerNetwork.pretrain:165 /
+    finetune:1331). ``net.fit(it)`` alone runs pretrain + finetune (the
+    config sets ``pretrain(True)``); to drive the phases separately use
+    ``net.pretrain(it)`` once then ``net.finetune(it)`` per epoch.
+    """
+    from ..nn.conf.layers import RBM
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).learning_rate(lr).updater(Sgd())
+         .list().pretrain(True))
+    prev = n_in
+    for h in hidden:
+        b.layer(RBM(n_in=prev, n_out=h, hidden_unit="binary",
+                    visible_unit="binary", k=1, activation="sigmoid"))
+        prev = h
+    b.layer(OutputLayer(n_in=prev, n_out=n_classes, activation="softmax",
+                        loss="negativeloglikelihood"))
+    return b.build()
+
+
+def deep_autoencoder_mnist(seed: int = 123, lr: float = 0.05,
+                           n_in: int = 784, bottleneck: int = 30,
+                           hidden: Optional[tuple] = None) -> MultiLayerConfiguration:
+    """Hinton-style deep autoencoder: RBM encoder stack to a small code,
+    mirrored decoder, sigmoid reconstruction with MSE.
+
+    Mirrors the reference's deep-autoencoder configuration (stacked RBM
+    layers pretrained layerwise, then end-to-end reconstruction finetuning;
+    reference nn/layers/feedforward/autoencoder + RBM stack). The decoder
+    half uses AutoEncoder layers so the whole net remains layerwise
+    pretrainable.
+    """
+    from ..nn.conf.layers import RBM, AutoEncoder
+    if hidden is None:
+        # geometric taper n_in -> bottleneck over two hidden widths
+        h1 = max(bottleneck, int(round((n_in ** 2 * bottleneck) ** (1 / 3))))
+        h2 = max(bottleneck, int(round((n_in * bottleneck ** 2) ** (1 / 3))))
+        hidden = (h1, h2)
+    dims = [n_in, *hidden, bottleneck]
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).learning_rate(lr).updater(Sgd())
+         .list().pretrain(True))
+    for a, c in zip(dims[:-1], dims[1:]):
+        b.layer(RBM(n_in=a, n_out=c, activation="sigmoid"))
+    rev = list(reversed(dims))
+    for a, c in zip(rev[:-1], rev[1:-1]):
+        b.layer(AutoEncoder(n_in=a, n_out=c, activation="sigmoid"))
+    b.layer(OutputLayer(n_in=dims[1], n_out=n_in, activation="sigmoid",
+                        loss="mse"))
+    return b.build()
 
 
 def transformer_lm(vocab_size: int = 77, d_model: int = 128, n_heads: int = 4,

@@ -1,0 +1,69 @@
+"""DBN + deep-autoencoder zoo models: pretrain -> finetune end to end.
+
+Mirrors the reference's signature stacked-RBM workloads (RBM CD-k layerwise
+pretraining via MultiLayerNetwork.pretrain:165, supervised/reconstruction
+finetuning via fit) on tiny shapes.
+"""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.models import dbn_mnist, deep_autoencoder_mnist
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _digits(n=96, d=36, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    protos = rng.uniform(0, 1, (classes, d)) > 0.5
+    y = rng.integers(0, classes, n)
+    x = (protos[y] ^ (rng.uniform(size=(n, d)) < 0.08)).astype(np.float32)
+    return x, np.eye(classes, dtype=np.float32)[y]
+
+
+def test_dbn_pretrain_finetune():
+    x, y = _digits()
+    conf = dbn_mnist(n_in=36, n_classes=4, hidden=(24, 16), lr=0.3)
+    net = MultiLayerNetwork(conf).init()
+    it = ListDataSetIterator(DataSet(x, y), batch=32)
+    net.pretrain(it)
+    assert np.isfinite(net.score_)
+    losses = []
+    for _ in range(60):
+        it.reset()
+        net.finetune(it)
+        losses.append(net.score_)
+    assert losses[-1] < losses[0]
+    it.reset()
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.8
+
+
+def test_deep_autoencoder_reconstruction():
+    x, _ = _digits(n=64, d=36)
+    conf = deep_autoencoder_mnist(n_in=36, bottleneck=8)
+    # autoencoder target == input
+    it = ListDataSetIterator(DataSet(x, x), batch=32)
+    net = MultiLayerNetwork(conf).init()
+    net.pretrain(it)
+    assert np.isfinite(net.score_)
+    losses = []
+    for _ in range(40):
+        it.reset()
+        net.finetune(it)
+        losses.append(net.score_)
+    assert losses[-1] < losses[0]
+    recon = np.asarray(net.output(x[:8]))
+    assert recon.shape == (8, 36)
+    assert np.all((recon >= 0) & (recon <= 1))
+
+
+def test_deep_autoencoder_layer_stack_shapes():
+    conf = deep_autoencoder_mnist(n_in=36, bottleneck=8)
+    dims = [(lc.n_in, lc.n_out) for lc in conf.layers]
+    # hidden widths taper geometrically between n_in and bottleneck, then
+    # mirror: 36 -> 22 -> 13 -> 8 -> 13 -> 22 -> 36
+    assert dims[0][0] == 36 and dims[-1][1] == 36
+    widths = [d[1] for d in dims[:3]]
+    assert widths == sorted(widths, reverse=True)  # monotone compression
+    mid = len(dims) // 2
+    assert dims[mid - 1][1] == 8 or dims[mid][0] == 8
