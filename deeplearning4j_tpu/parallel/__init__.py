@@ -4,7 +4,7 @@ parallelism, fault tolerance, driver facades (SURVEY.md §2.4 analog).
 The names below are the public surface a driver program uses. Importing
 this package initializes jax (the submodules need it at import time).
 """
-from .mesh import DATA_AXIS, default_mesh, make_mesh
+from .mesh import DATA_AXIS, default_mesh, hybrid_mesh, make_mesh
 from .trainer import (IciDataParallelTrainingMaster, ParallelWrapper,
                       ParameterAveragingTrainingMaster, TrainingMaster)
 from .statetracker import TrainingStateTracker, fit_with_recovery
@@ -20,7 +20,7 @@ from .stats import (NTPTimeSource, SparkTrainingStats, SystemClockTimeSource,
                     TimeSource, device_trace, phase_timer)
 
 __all__ = [
-    "DATA_AXIS", "default_mesh", "make_mesh",
+    "DATA_AXIS", "default_mesh", "hybrid_mesh", "make_mesh",
     "TrainingMaster", "IciDataParallelTrainingMaster",
     "ParameterAveragingTrainingMaster", "ParallelWrapper",
     "TrainingStateTracker", "fit_with_recovery", "ConfigurationRegistry",

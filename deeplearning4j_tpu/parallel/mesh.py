@@ -53,6 +53,48 @@ def make_mesh(shape: dict) -> Mesh:
     return Mesh(grid, tuple(shape.keys()))
 
 
+def hybrid_mesh(dcn_shape: dict, ici_shape: dict) -> Mesh:
+    """Multi-slice mesh: DCN axes outermost (across slices), ICI axes within.
+
+    The multi-pod topology the reference reaches with Spark executor
+    placement across hosts (SURVEY.md §2.4: driver -> executors over TCP) is
+    expressed here as mesh geometry: axes in ``dcn_shape`` vary across TPU
+    slices (collectives on them ride the data-center network) and axes in
+    ``ici_shape`` vary within a slice (collectives ride ICI). Shard weights
+    over ICI axes and batch over DCN axes so the per-step all-reduce volume
+    crossing DCN is the small gradient-sum, never activations — the
+    scaling-book recipe.
+
+    On hardware, devices carry ``slice_index``; devices of one slice form one
+    row-block. On single-slice (or CPU test) topologies, contiguous blocks of
+    ``prod(ici_shape)`` devices stand in for slices so the same code runs
+    under `--xla_force_host_platform_device_count`.
+    """
+    dcn_axes, ici_axes = tuple(dcn_shape), tuple(ici_shape)
+    overlap = set(dcn_axes) & set(ici_axes)
+    if overlap:
+        raise ValueError(f"axis names must be unique across dcn/ici: {overlap}")
+    n_slices = int(np.prod([int(s) for s in dcn_shape.values()]))
+    per_slice = int(np.prod([int(s) for s in ici_shape.values()]))
+    devs = jax.devices()
+    if n_slices * per_slice > len(devs):
+        raise ValueError(f"hybrid mesh {dcn_shape}x{ici_shape} needs "
+                         f"{n_slices * per_slice} devices, have {len(devs)}")
+    by_slice: dict = {}
+    for d in devs:
+        by_slice.setdefault(getattr(d, "slice_index", None) or 0, []).append(d)
+    usable = [sorted(v, key=lambda d: d.id)[:per_slice]
+              for _, v in sorted(by_slice.items())
+              if len(v) >= per_slice][:n_slices]
+    if len(usable) < n_slices:
+        # pseudo-slices: contiguous device blocks (single-slice / CPU test)
+        return make_mesh({**dcn_shape, **ici_shape})
+    grid = np.asarray(usable).reshape(
+        [int(s) for s in dcn_shape.values()] +
+        [int(s) for s in ici_shape.values()])
+    return Mesh(grid, dcn_axes + ici_axes)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -1,0 +1,71 @@
+"""Hybrid ICI x DCN (multi-slice) mesh: geometry + training equivalence.
+
+The multi-pod analog of the reference's Spark driver->executors topology
+(SURVEY.md §2.4): DCN axes vary across slices, ICI axes within one. On the
+virtual 8-device CPU mesh, contiguous blocks stand in for slices.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ListDataSetIterator, MultiLayerNetwork,
+                                NeuralNetConfiguration, Sgd)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.mesh import hybrid_mesh
+from deeplearning4j_tpu.parallel.trainer import IciDataParallelTrainingMaster
+
+
+def _net(seed=12345, lr=0.1):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(Sgd())
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=10, activation="tanh"))
+            .layer(OutputLayer(n_in=10, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=5):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def test_hybrid_mesh_geometry():
+    mesh = hybrid_mesh({"data": 2}, {"model": 4})
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.devices.shape == (2, 4)
+    # each DCN row is one (pseudo-)slice: contiguous device ids
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert ids[0].tolist() == sorted(ids[0].tolist())
+    assert set(ids[0]) & set(ids[1]) == set()
+
+
+def test_hybrid_mesh_rejects_duplicate_axes():
+    with pytest.raises(ValueError):
+        hybrid_mesh({"data": 2}, {"data": 4})
+
+
+def test_hybrid_mesh_rejects_oversize():
+    with pytest.raises(ValueError):
+        hybrid_mesh({"data": 64}, {"model": 64})
+
+
+def test_training_on_hybrid_mesh_matches_single_device():
+    """dp over the DCN axis of a 2x4 hybrid mesh == plain single-device SGD
+    (the golden-test discipline of TestCompareParameterAveragingSparkVsSingleMachine)."""
+    ds = _data(64)
+    single = _net()
+    for _ in range(5):
+        single.fit(ds.features, ds.labels)
+
+    dist = _net()
+    mesh = hybrid_mesh({"data": 2}, {"model": 4})
+    master = IciDataParallelTrainingMaster(mesh=mesh)
+    it = ListDataSetIterator(ds, 64)
+    for _ in range(5):
+        master.execute_training(dist, it)
+    np.testing.assert_allclose(single.params_flat(), dist.params_flat(),
+                               rtol=2e-5, atol=2e-6)
