@@ -26,7 +26,7 @@ from .conf.graph import (ComputationGraphConfiguration,
                          MergeVertex, PreprocessorVertex, ScaleVertex,
                          SubsetVertex)
 from .conf.layers import OutputLayer, RnnOutputLayer, LossLayer
-from .layers.base import LayerImpl, impl_for
+from .layers.base import LayerImpl, impl_for, remat_forward
 from .layers.recurrent import BaseRecurrentImpl
 from .conf.config import BACKPROP_TBPTT
 from .multilayer import _dtype_of
@@ -103,14 +103,17 @@ class ComputationGraph:
             if vertex.preprocessor is not None:
                 x = vertex.preprocessor.preprocess(x)
             impl = self._impls[name]
+            ckpt = train and getattr(self.conf.conf, "remat", False)
             if isinstance(impl, BaseRecurrentImpl):
                 state0 = (states or {}).get(name)
-                y, st = impl.forward_with_state(params[name], x, state0,
-                                                train=train, rng=rng, mask=mask)
+                y, st = remat_forward(impl, train=train, ckpt=ckpt,
+                                      recurrent=True)(
+                    params[name], x, state0, rng, mask)
                 new_states[name] = st
                 return y, variables.get(name, {})
-            y, nv = impl.forward(params[name], x, train=train, rng=rng,
-                                 variables=variables.get(name, {}), mask=mask)
+            y, nv = remat_forward(impl, train=train, ckpt=ckpt,
+                                  recurrent=False)(
+                params[name], x, variables.get(name, {}), rng, mask)
             return y, nv
         if isinstance(vertex, MergeVertex):
             return jnp.concatenate(inputs, axis=-1), None

@@ -25,6 +25,25 @@ Variables = Dict[str, Array]
 LAYER_IMPLS: Dict[str, Type["LayerImpl"]] = {}
 
 
+def remat_forward(impl, *, train: bool, ckpt: bool, recurrent: bool):
+    """Bind a layer impl's forward into positional-tracer form and, when
+    ``ckpt``, wrap it in jax.checkpoint (layer-granularity rematerialization:
+    backward recomputes layer internals instead of storing them — the
+    HBM<->FLOPs trade behind `NeuralNetConfiguration.remat`).
+
+    Positional signature: recurrent -> f(params, x, state0, rng, mask);
+    feed-forward -> f(params, x, variables, rng, mask). Static flags stay
+    closed over so Python control flow inside forward still works.
+    """
+    if recurrent:
+        def fwd(p, c, s, r, m):
+            return impl.forward_with_state(p, c, s, train=train, rng=r, mask=m)
+    else:
+        def fwd(p, c, v, r, m):
+            return impl.forward(p, c, train=train, rng=r, variables=v, mask=m)
+    return jax.checkpoint(fwd) if ckpt else fwd
+
+
 def register_impl(conf_cls_name: str):
     def deco(cls):
         LAYER_IMPLS[conf_cls_name] = cls

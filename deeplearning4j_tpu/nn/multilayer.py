@@ -28,7 +28,7 @@ from .conf.config import (BACKPROP_TBPTT, MultiLayerConfiguration,
                           NeuralNetConfiguration)
 from .conf.preprocessors import (CnnToRnnPreProcessor,
                                  FeedForwardToRnnPreProcessor)
-from .layers.base import LayerImpl, impl_for
+from .layers.base import LayerImpl, impl_for, remat_forward
 from .layers.pretrain import AutoEncoderImpl, RBMImpl
 from .layers.recurrent import BaseRecurrentImpl
 from .updater.gradnorm import apply_gradient_normalization
@@ -142,14 +142,17 @@ class MultiLayerNetwork:
                 timesteps = cur.shape[1]
             impl = self._impls[i]
             lmask_arg = fmask if cur.ndim == 3 else None
+            ckpt = train and getattr(conf.conf, "remat", False)
             if isinstance(impl, BaseRecurrentImpl):
                 state0 = (states or {}).get(i)
-                y, st = impl.forward_with_state(params[i], cur, state0, train=train,
-                                                rng=rngs[i], mask=lmask_arg)
+                y, st = remat_forward(impl, train=train, ckpt=ckpt,
+                                      recurrent=True)(
+                    params[i], cur, state0, rngs[i], lmask_arg)
                 new_states[i] = st
             else:
-                y, nv = impl.forward(params[i], cur, train=train, rng=rngs[i],
-                                     variables=variables[i], mask=lmask_arg)
+                y, nv = remat_forward(impl, train=train, ckpt=ckpt,
+                                      recurrent=False)(
+                    params[i], cur, variables[i], rngs[i], lmask_arg)
                 new_vars[i] = nv
             acts.append(y)
             cur = y

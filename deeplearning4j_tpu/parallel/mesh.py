@@ -87,6 +87,14 @@ def hybrid_mesh(dcn_shape: dict, ici_shape: dict) -> Mesh:
               for _, v in sorted(by_slice.items())
               if len(v) >= per_slice][:n_slices]
     if len(usable) < n_slices:
+        if len(by_slice) > 1:
+            # real multi-slice hardware whose layout can't host this
+            # geometry: refuse rather than silently letting an "ICI" axis
+            # span slices (its collectives would ride DCN)
+            raise ValueError(
+                f"hybrid mesh {dcn_shape}x{ici_shape} does not fit the "
+                f"slice layout {[len(v) for v in by_slice.values()]} "
+                f"(need {n_slices} slices of >= {per_slice} devices)")
         # pseudo-slices: contiguous device blocks (single-slice / CPU test)
         return make_mesh({**dcn_shape, **ici_shape})
     grid = np.asarray(usable).reshape(
