@@ -97,7 +97,8 @@ class ComputationGraph:
     # -- vertex forward --------------------------------------------------------
     def _vertex_forward(self, name: str, vertex: GraphVertex,
                         inputs: List[Array], params, variables, *,
-                        train, rng, mask, vmasks, states, new_states):
+                        train, rng, mask, vmasks, states, new_states,
+                        in_scan: bool = False):
         if isinstance(vertex, LayerVertex):
             x = inputs[0]
             if vertex.preprocessor is not None:
@@ -107,12 +108,12 @@ class ComputationGraph:
             if isinstance(impl, BaseRecurrentImpl):
                 state0 = (states or {}).get(name)
                 y, st = remat_forward(impl, train=train, ckpt=ckpt,
-                                      recurrent=True)(
+                                      recurrent=True, in_scan=in_scan)(
                     params[name], x, state0, rng, mask)
                 new_states[name] = st
                 return y, variables.get(name, {})
             y, nv = remat_forward(impl, train=train, ckpt=ckpt,
-                                  recurrent=False)(
+                                  recurrent=False, in_scan=in_scan)(
                 params[name], x, variables.get(name, {}), rng, mask)
             return y, nv
         if isinstance(vertex, MergeVertex):
@@ -160,7 +161,8 @@ class ComputationGraph:
         raise ValueError(f"Unknown vertex type {type(vertex).__name__}")
 
     def _forward_impl(self, params, variables, inputs: Sequence[Array], *,
-                      train, rng, fmasks=None, states=None):
+                      train, rng, fmasks=None, states=None,
+                      in_scan: bool = False):
         """Topo-ordered DAG forward. Returns (dict name->activation,
         new variables, new rnn states)."""
         conf = self.conf
@@ -198,7 +200,8 @@ class ComputationGraph:
             y, nv = self._vertex_forward(
                 name, vertex, vin, params, variables,
                 train=train, rng=layer_rng.get(name), mask=in_mask,
-                vmasks=vmasks, states=states, new_states=new_states)
+                vmasks=vmasks, states=states, new_states=new_states,
+                in_scan=in_scan)
             if nv is not None:
                 new_vars[name] = nv
             acts[name] = y
@@ -272,17 +275,19 @@ class ComputationGraph:
             new_ustates[name] = lu
         return new_params, new_ustates
 
-    def _build_train_step(self):
+    def _build_train_step(self, in_scan: bool = False):
         """Raw (unjitted) pure train step — reused by the distributed
         trainers (parallel/) inside shard_map, mirroring
         MultiLayerNetwork._build_train_step. (jit retraces per input pytree
         structure, so no shape key is needed here; _get_train_step's key is
-        purely a cache discriminator.)"""
+        purely a cache discriminator.) ``in_scan`` marks steps traced inside
+        a lax.scan body (remat drops its CSE barriers there)."""
 
         def loss_fn(params, variables, inputs, labels, fmasks, lmasks, rng):
             acts, new_vars, _ = self._forward_impl(params, variables, inputs,
                                                    train=True, rng=rng,
-                                                   fmasks=fmasks)
+                                                   fmasks=fmasks,
+                                                   in_scan=in_scan)
             loss = self._loss(acts, labels, lmasks) + self._reg_loss(params)
             return loss, new_vars
 
@@ -422,7 +427,7 @@ class ComputationGraph:
         ys_list = [jnp.asarray(a) for a in ys_list]
         cache_key = ("multi", len(xs_list), len(ys_list))
         if cache_key not in self._jit_cache:
-            base = self._build_train_step()
+            base = self._build_train_step(in_scan=True)
 
             def multi(params, variables, ustates, step0, rng, xs, ys):
                 def body(carry, inp):

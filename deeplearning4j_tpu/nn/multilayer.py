@@ -113,7 +113,8 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------- forward ---
     def _forward_impl(self, params, variables, x, *, train, rng, fmask=None,
-                      states=None, upto: Optional[int] = None):
+                      states=None, upto: Optional[int] = None,
+                      in_scan: bool = False):
         """Pure forward through layers [0, upto). Returns
         (activations per layer, new variables, new rnn states)."""
         conf = self.conf
@@ -146,12 +147,12 @@ class MultiLayerNetwork:
             if isinstance(impl, BaseRecurrentImpl):
                 state0 = (states or {}).get(i)
                 y, st = remat_forward(impl, train=train, ckpt=ckpt,
-                                      recurrent=True)(
+                                      recurrent=True, in_scan=in_scan)(
                     params[i], cur, state0, rngs[i], lmask_arg)
                 new_states[i] = st
             else:
                 y, nv = remat_forward(impl, train=train, ckpt=ckpt,
-                                      recurrent=False)(
+                                      recurrent=False, in_scan=in_scan)(
                     params[i], cur, variables[i], rngs[i], lmask_arg)
                 new_vars[i] = nv
             acts.append(y)
@@ -208,15 +209,17 @@ class MultiLayerNetwork:
             new_ustates.append(lu)
         return new_params, new_ustates
 
-    def _build_train_step(self, key):
+    def _build_train_step(self, key, in_scan: bool = False):
         """Build the raw (unjitted) pure train step — reused by the
-        distributed trainers (parallel/) inside shard_map."""
+        distributed trainers (parallel/) inside shard_map. ``in_scan`` marks
+        steps traced inside a lax.scan body (remat drops its CSE barriers
+        there; see layers/base.remat_forward)."""
         has_fmask, has_lmask, carry_state = key
 
         def loss_fn(params, variables, x, y, fmask, lmask, rng, states):
             acts, new_vars, new_states = self._forward_impl(
                 params, variables, x, train=True, rng=rng, fmask=fmask,
-                states=states if carry_state else None)
+                states=states if carry_state else None, in_scan=in_scan)
             out = acts[-1]
             loss = self._loss_from_output(out, y, lmask) + self._reg_loss(params)
             return loss.astype(jnp.float32), (new_vars, new_states)
@@ -244,7 +247,8 @@ class MultiLayerNetwork:
         the TPU answer to the reference's per-minibatch Solver.optimize()
         round trip (MultiLayerNetwork.java:1033-1062)."""
         has_fmask, has_lmask = key
-        base = self._build_train_step((has_fmask, has_lmask, False))
+        base = self._build_train_step((has_fmask, has_lmask, False),
+                                      in_scan=True)
 
         def multi_step(params, variables, ustates, step0, rng, xs, ys, fms, lms):
             def body(carry, inp):

@@ -86,14 +86,16 @@ def _ones_lmask(y, need: int, orig: int) -> np.ndarray:
     return m
 
 
-def _unified_step(net, has_fm: bool, has_lm: bool):
+def _unified_step(net, has_fm: bool, has_lm: bool, in_scan: bool = False):
     """A facade-independent pure train step
     (params, variables, ustates, step, rng, inputs, labels, fmasks, lmasks)
     -> (params, variables, ustates, loss) with list-typed inputs/labels/masks
     — lets both masters drive MultiLayerNetwork AND ComputationGraph
-    (reference SparkDl4jMultiLayer + SparkComputationGraph.java:63,133)."""
+    (reference SparkDl4jMultiLayer + SparkComputationGraph.java:63,133).
+    ``in_scan``: the caller traces this step inside a lax.scan body (remat
+    drops its CSE barriers there; see nn/layers/base.remat_forward)."""
     if _is_graph(net):
-        raw = net._build_train_step()
+        raw = net._build_train_step(in_scan=in_scan)
         in_names = net.conf.network_inputs
 
         def step(p, v, u, s, rng, inputs, labels, fmasks, lmasks):
@@ -101,7 +103,7 @@ def _unified_step(net, has_fm: bool, has_lm: bool):
             return raw(p, v, u, s, rng, inputs, labels, fmd, lmasks)
         return step
 
-    raw = net._build_train_step((has_fm, has_lm, False))
+    raw = net._build_train_step((has_fm, has_lm, False), in_scan=in_scan)
 
     def step(p, v, u, s, rng, inputs, labels, fmasks, lmasks):
         np_, nv, nu, loss, _ = raw(
@@ -264,7 +266,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                has_fm)
         if key in net._jit_cache:
             return net._jit_cache[key]
-        raw_step = _unified_step(net, has_fm, True)
+        raw_step = _unified_step(net, has_fm, True, in_scan=True)
         mesh = self.mesh
 
         def worker_round(params, variables, ustates, step, rng, xs, ys, fs, ls):

@@ -1,0 +1,35 @@
+"""Deep Belief Network on MNIST digits — layerwise CD-k pretraining then
+supervised finetuning (the reference's signature workflow:
+MultiLayerNetwork.pretrain:165 -> finetune:1331).
+
+Run: python examples/deep_belief_net.py [--epochs N]
+"""
+import argparse
+
+from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
+from deeplearning4j_tpu.models.zoo import dbn_mnist
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def main(epochs: int = 30, num_examples: int = 1024, batch: int = 128) -> float:
+    train = MnistDataSetIterator(batch=batch, num_examples=num_examples)
+    # binarize-friendly sizes: MNIST rows are flat [N, 784] in [0, 1]
+    net = MultiLayerNetwork(dbn_mnist(n_in=784, n_classes=10,
+                                      hidden=(256, 128), lr=0.1)).init()
+    train.reset()
+    net.pretrain(train)          # unsupervised stacked-RBM phase
+    print(f"pretrain done, last RBM reconstruction score={net.score_:.4f}")
+    acc = 0.0
+    for epoch in range(epochs):  # supervised phase
+        train.reset()
+        net.finetune(train)
+        train.reset()
+        acc = net.evaluate(train).accuracy()
+        print(f"epoch {epoch + 1}: accuracy={acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=30)
+    main(p.parse_args().epochs)

@@ -34,6 +34,12 @@ def test_serving_example():
     assert model_serving.main() == 5
 
 
+def test_deep_belief_net_example():
+    import deep_belief_net
+    acc = deep_belief_net.main(epochs=20, num_examples=256, batch=64)
+    assert acc > 0.6
+
+
 def test_transformer_example():
     import transformer_lm
     acc = transformer_lm.main(steps=60, vocab=11, seq_len=12, batch=16)

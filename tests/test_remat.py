@@ -53,6 +53,30 @@ def test_lstm_remat_matches_baseline():
     assert abs(scores[0] - scores[1]) < 1e-5
 
 
+def test_remat_fit_scan_matches_baseline():
+    """The scan path (in_scan=True -> prevent_cse=False) keeps numerics."""
+    rng = np.random.default_rng(2)
+    x, y = _onehot_stream(rng, 4, 12, 13)
+    xs = np.stack([x] * 4)
+    ys = np.stack([y] * 4)
+    params = []
+    for remat in (False, True):
+        from deeplearning4j_tpu.nn.conf.config import (NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM,
+                                                       RnnOutputLayer)
+        conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.05)
+                .remat(remat)
+                .list()
+                .layer(GravesLSTM(n_in=13, n_out=16, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=16, n_out=13, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit_scan(xs, ys)
+        params.append(net.params_flat())
+    np.testing.assert_allclose(params[0], params[1], rtol=1e-5, atol=1e-6)
+
+
 def test_remat_builder_flag_serde():
     from deeplearning4j_tpu.nn.conf.config import MultiLayerConfiguration
     conf = char_rnn_lstm(vocab_size=9, hidden=8)
