@@ -208,3 +208,54 @@ def test_attention_layer_uses_seam():
         assert seen and seen[0] == (2, 6, 2, 4)
     finally:
         helpers.register_helper("attention", None)
+
+
+def test_autotune_probe_escapes_ambient_trace():
+    """Regression: helpers are first called while a train step is being
+    jit-traced; the probe measurement must escape the ambient trace or
+    every decision silently collapses to the XLA fallback
+    (ConcretizationTypeError swallowed by the gate's except-clause)."""
+    import jax.numpy as jnp
+
+    @pallas_kernels._eagerly
+    def probe():
+        q = jnp.ones((8, 8), jnp.float32)
+        j = jax.jit(lambda a: a @ a)
+        return pallas_kernels._measure_thunk(lambda: j(q))
+
+    t_top = probe()
+    assert t_top >= 0.0
+    seen = {}
+
+    def traced(x):
+        seen["t"] = probe()  # runs at trace time, inside jit
+        return x * 2
+
+    jax.jit(traced)(jnp.ones((2,), jnp.float32))
+    assert seen["t"] >= 0.0  # raised ConcretizationTypeError before the fix
+
+
+def test_splash_attention_parity_interpreter():
+    """_splash_call (the long-context walkover backend) must match the
+    dense XLA attention; runs under the Pallas interpreter on CPU so a
+    transpose or scale-fold mistake cannot ship silently."""
+    import numpy as np
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops import helpers
+
+    old = pallas_kernels._INTERPRET
+    pallas_kernels._INTERPRET = True
+    try:
+        rng = np.random.default_rng(0)
+        B, L, H, D = 1, 256, 2, 128
+        q = jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+        for causal in (True, False):
+            ref = helpers._attention_default(q, k, v, causal=causal,
+                                             scale=None)
+            out = pallas_kernels._splash_call(q, k, v, causal, None)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-5)
+    finally:
+        pallas_kernels._INTERPRET = old
