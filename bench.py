@@ -46,6 +46,39 @@ def _flops_of(jitted, *args):
         return None
 
 
+def _lm_onehot(rng, vocab, t, b, k=None):
+    """Next-token one-hot pairs for the transformer workloads.
+    k=None -> ([B,T,V], [B,T,V]); k -> stacked ([K,B,T,V], [K,B,T,V])."""
+    import jax.numpy as jnp
+    shape = (b, t + 1) if k is None else (k, b, t + 1)
+    ids = np.random.default_rng(0).integers(0, vocab, shape) if rng is None \
+        else rng.integers(0, vocab, shape)
+    eye = np.eye(vocab, dtype=np.float32)
+    return jnp.asarray(eye[ids[..., :-1]]), jnp.asarray(eye[ids[..., 1:]])
+
+
+def _time_graph_raw_steps(net, xs, ys, iters):
+    """Drive a ComputationGraph's raw jitted train step `iters` times
+    (single-step dispatch; the scan path is exercised by workload 4b).
+    Returns (sec/step, flops/step, first loss, last loss)."""
+    import jax
+    import jax.numpy as jnp
+    sf = net._get_train_step((1, 1, False, False))
+    fl = _flops_of(sf, net.params, net.variables, net.updater_state,
+                   jnp.asarray(0), jax.random.PRNGKey(0), [xs], [ys],
+                   None, None)
+    p, v, u, loss = sf(net.params, net.variables, net.updater_state,
+                       jnp.asarray(0), jax.random.PRNGKey(0), [xs], [ys],
+                       None, None)
+    first = float(loss)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        p, v, u, loss = sf(p, v, u, jnp.asarray(i + 1),
+                           jax.random.PRNGKey(i), [xs], [ys], None, None)
+    last = float(loss)
+    return (time.perf_counter() - t0) / iters, fl, first, last
+
+
 def _bench_net(name, conf, x, y, batch, warmup, steps, dtype, scan_k=16):
     """Time training through the public multi-step path (fit_scan): K
     minibatches per device dispatch, losses fetched once per chunk."""
@@ -227,9 +260,7 @@ def main() -> None:
     gnet = ComputationGraph(transformer_lm(vocab_size=Vt, d_model=512,
                                            n_heads=8, n_blocks=4,
                                            dtype=dtype)).init()
-    ids = rng.integers(0, Vt, (8, Bt, Tt + 1))
-    gxs = jnp.asarray(np.eye(Vt, dtype=np.float32)[ids[:, :, :-1]])
-    gys = jnp.asarray(np.eye(Vt, dtype=np.float32)[ids[:, :, 1:]])
+    gxs, gys = _lm_onehot(rng, Vt, Tt, Bt, k=8)
     gsf = gnet._get_train_step((1, 1, False, False))
     gfl = _flops_of(gsf, gnet.params, gnet.variables, gnet.updater_state,
                     jnp.asarray(0), jax.random.PRNGKey(0), [gxs[0]],
@@ -252,6 +283,35 @@ def main() -> None:
         "loss_last": round(float(gl[-1]), 4),
         "config": "d_model=512 n_blocks=4 n_heads=8 T=256 B=32 causal",
     }
+
+    # ---- 4c. LONG-CONTEXT transformer: T=8192 end-to-end training with the
+    # helper seam's autotuned attention kernel (the workload the fixed
+    # trace-escaping autotune unlocks; dense XLA alone runs ~117 ms/step) --
+    if on_tpu:
+        Vl, Tl, Bl = 128, 8192, 1
+        lxs, lys = _lm_onehot(rng, Vl, Tl, Bl)
+        pallas_kernels.enable(interpret=False)
+        try:
+            lnet = ComputationGraph(transformer_lm(
+                vocab_size=Vl, d_model=512, n_heads=8, n_blocks=4,
+                dtype=dtype)).init()
+            ldt, lfl, l_first, l_last = _time_graph_raw_steps(
+                lnet, lxs, lys, iters=20)
+            WORKLOADS["transformer_lm_long"] = {
+                "tokens_per_sec": round(Bl * Tl / ldt, 1),
+                "step_ms": round(ldt * 1e3, 3),
+                "mfu": round(lfl / ldt / PEAK_FLOPS[dtype], 4) if lfl else None,
+                "flops_per_step": lfl,
+                "loss_first": round(l_first, 4),
+                "loss_last": round(l_last, 4),
+                "attention_decisions": {
+                    str(k): v for k, v in
+                    pallas_kernels.autotune_decisions().items()
+                    if k[0] == "attention"},
+                "config": "d_model=512 n_blocks=4 n_heads=8 T=8192 B=1 causal",
+            }
+        finally:
+            pallas_kernels.disable()
 
     # ---- 5. Word2Vec skip-gram words/sec (synthetic zipf corpus; text8 is
     # unfetchable here — zero egress) -----------------------------------------
