@@ -291,6 +291,8 @@ def main() -> None:
         Vl, Tl, Bl = 128, 8192, 1
         lxs, lys = _lm_onehot(rng, Vl, Tl, Bl)
         pallas_kernels.enable(interpret=False)
+        pallas_kernels.clear_autotune_cache()  # attribute only THIS
+        # workload's shapes in attention_decisions (4a2 probes D=128)
         try:
             lnet = ComputationGraph(transformer_lm(
                 vocab_size=Vl, d_model=512, n_heads=8, n_blocks=4,

@@ -12,6 +12,7 @@ from .registry import ConfigurationRegistry
 from .pipeline import GPipeExecutor, stack_block_params
 from .moe import MoEExecutor
 from .spark_api import SparkComputationGraph, SparkDl4jMultiLayer
+from .tensor_parallel import shard_transformer_tp
 from .evaluation import (DistributedDataSetLossCalculator,
                          DistributedEarlyStoppingTrainer,
                          distributed_evaluate, distributed_score)
@@ -25,7 +26,7 @@ __all__ = [
     "ParameterAveragingTrainingMaster", "ParallelWrapper",
     "TrainingStateTracker", "fit_with_recovery", "ConfigurationRegistry",
     "GPipeExecutor", "stack_block_params", "MoEExecutor",
-    "SparkDl4jMultiLayer", "SparkComputationGraph",
+    "SparkDl4jMultiLayer", "SparkComputationGraph", "shard_transformer_tp",
     "distributed_evaluate", "distributed_score",
     "DistributedDataSetLossCalculator", "DistributedEarlyStoppingTrainer",
     "full_attention", "ring_attention", "ulysses_attention",

@@ -180,9 +180,22 @@ class IciDataParallelTrainingMaster(TrainingMaster):
         net._check_init()
         repl = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
-        net.params = _tree_put(net.params, repl)
+
+        def keep_or_repl(a):
+            # DP x TP composition: arrays already annotated on THIS mesh
+            # (e.g. by parallel.tensor_parallel.shard_transformer_tp) keep
+            # their sharding; everything else replicates. Blanket
+            # replication here used to silently strip TP annotations.
+            s = getattr(a, "sharding", None)
+            if isinstance(s, NamedSharding) and s.mesh == self.mesh:
+                return a
+            return jax.device_put(np.asarray(a), repl) \
+                if jax.process_count() > 1 else jax.device_put(a, repl)
+
+        net.params = jax.tree_util.tree_map(keep_or_repl, net.params)
         net.variables = _tree_put(net.variables, repl)
-        net.updater_state = _tree_put(net.updater_state, repl)
+        net.updater_state = jax.tree_util.tree_map(keep_or_repl,
+                                                   net.updater_state)
         n_dev = self.mesh.size
         # resumed run: skip the batches already trained before the restored
         # checkpoint (call resume(net) first; the iterator must replay the
