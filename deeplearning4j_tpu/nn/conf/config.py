@@ -73,7 +73,12 @@ class NeuralNetConfiguration:
     mini_batch: Optional[bool] = None  # reference alias
     max_num_line_search_iterations: int = 5
     step_function: str = "negative_gradient"
-    dtype: str = "float32"  # compute dtype: float32 | bfloat16
+    dtype: str = "float32"  # parameter dtype: float32 | bfloat16
+    # Mixed precision: when set (e.g. "bfloat16"), forward/backward compute
+    # runs in this dtype while parameters, updater state, and BatchNorm
+    # running stats stay in `dtype` (f32 master weights — the TPU-native
+    # mixed-precision recipe; no loss scaling needed for bf16).
+    compute_dtype: Optional[str] = None
     remat: bool = False  # jax.checkpoint the forward pass (HBM <-> FLOPs trade)
 
     @staticmethod

@@ -29,7 +29,7 @@ from .conf.layers import OutputLayer, RnnOutputLayer, LossLayer
 from .layers.base import LayerImpl, impl_for, remat_forward
 from .layers.recurrent import BaseRecurrentImpl
 from .conf.config import BACKPROP_TBPTT
-from .multilayer import _dtype_of
+from .multilayer import _cast_floats, _compute_dtype_of, _dtype_of
 from .updater.gradnorm import apply_gradient_normalization
 from .updater.schedules import effective_lr
 from ..ops import losses as losses_mod
@@ -166,7 +166,10 @@ class ComputationGraph:
         """Topo-ordered DAG forward. Returns (dict name->activation,
         new variables, new rnn states)."""
         conf = self.conf
-        dtype = _dtype_of(conf.conf)
+        dtype = _compute_dtype_of(conf.conf)
+        if dtype != _dtype_of(conf.conf):
+            # mixed precision: see multilayer._forward_impl
+            params = _cast_floats(params, dtype)
         acts: Dict[str, Array] = {}
         # per-vertex feature-mask propagation (reference tracks masks through
         # vertices via setLayerMaskArrays/feedForward(...,fMask,...)); a vertex
@@ -204,6 +207,10 @@ class ComputationGraph:
                 in_scan=in_scan)
             if nv is not None:
                 new_vars[name] = nv
+            if (getattr(y, "ndim", None) is not None
+                    and jnp.issubdtype(y.dtype, jnp.floating)
+                    and y.dtype != dtype):
+                y = y.astype(dtype)  # stop f32 creep under mixed precision
             acts[name] = y
             if isinstance(vertex, DuplicateToTimeSeriesVertex):
                 vmasks[name] = vmasks.get(vertex.reference_input)
@@ -513,7 +520,7 @@ class ComputationGraph:
         batch = inputs[0].shape[0]
         # state dtype = the network compute dtype (NOT input[0].dtype:
         # the first input may be integer embedding indices)
-        dtype = _dtype_of(self.conf.conf)
+        dtype = _compute_dtype_of(self.conf.conf)
         states = {name: impl.init_state(batch, dtype)
                   for name, impl in self._impls.items()
                   if isinstance(impl, BaseRecurrentImpl)}

@@ -42,6 +42,29 @@ def _dtype_of(conf: NeuralNetConfiguration):
     return {"bfloat16": jnp.bfloat16, "float64": jnp.float64}.get(conf.dtype, jnp.float32)
 
 
+_COMPUTE_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                   "float64": jnp.float64}
+
+
+def _compute_dtype_of(conf: NeuralNetConfiguration):
+    """Forward/backward compute dtype: `compute_dtype` when set (mixed
+    precision with f32 master weights), else the parameter dtype."""
+    cd = getattr(conf, "compute_dtype", None)
+    if cd:
+        if cd not in _COMPUTE_DTYPES:
+            raise ValueError(
+                f"Unsupported compute_dtype '{cd}' "
+                f"(supported: {sorted(_COMPUTE_DTYPES)})")
+        return _COMPUTE_DTYPES[cd]
+    return _dtype_of(conf)
+
+
+def _cast_floats(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a, tree)
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -129,7 +152,12 @@ class MultiLayerNetwork:
         new_vars = list(variables)
         new_states: Dict[int, Any] = {}
         cur = x
-        dtype = _dtype_of(conf.conf)
+        dtype = _compute_dtype_of(conf.conf)
+        if dtype != _dtype_of(conf.conf):
+            # mixed precision: params cast to the compute dtype for the
+            # traced math; autodiff casts grads back to the (f32) master
+            # params, and the updater runs in master precision
+            params = _cast_floats(params, dtype)
         if jnp.issubdtype(cur.dtype, jnp.floating) and cur.dtype != dtype:
             cur = cur.astype(dtype)  # cast input to the net's compute dtype
         for i in range(n):
@@ -155,6 +183,8 @@ class MultiLayerNetwork:
                                       recurrent=False, in_scan=in_scan)(
                     params[i], cur, variables[i], rngs[i], lmask_arg)
                 new_vars[i] = nv
+            if jnp.issubdtype(y.dtype, jnp.floating) and y.dtype != dtype:
+                y = y.astype(dtype)  # stop f32 creep (e.g. BN's f32 stats)
             acts.append(y)
             cur = y
         return acts, new_vars, new_states
@@ -503,7 +533,8 @@ class MultiLayerNetwork:
         y = jnp.asarray(y)
         T = x.shape[1]
         L = self.conf.tbptt_fwd_length
-        states = {i: impl.init_state(x.shape[0], x.dtype)
+        states = {i: impl.init_state(x.shape[0],
+                                     _compute_dtype_of(self.conf.conf))
                   for i, impl in enumerate(self._impls)
                   if isinstance(impl, BaseRecurrentImpl)}
         start = 0

@@ -1,0 +1,120 @@
+"""Mixed precision (`.compute_dtype("bfloat16")`): f32 master weights,
+bf16 compute — the TPU-native recipe (no loss scaling needed for bf16).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import (MultiLayerNetwork, NeuralNetConfiguration,
+                                Sgd)
+from deeplearning4j_tpu.nn.conf.config import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _cnn_conf(compute_dtype=None):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater(Sgd())
+            .compute_dtype(compute_dtype)
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                    padding=(1, 1), activation="identity"))
+            .layer(BatchNormalization(activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+
+
+def _img_data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8, 8, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def test_mixed_precision_keeps_f32_master_state():
+    x, y = _img_data()
+    net = MultiLayerNetwork(_cnn_conf("bfloat16")).init()
+    losses = []
+    for _ in range(20):
+        net.fit(x, y)
+        losses.append(net.score_)
+    # master params, updater state, and BN running stats all stay f32
+    for lp in net.params:
+        for a in lp.values():
+            assert a.dtype == jnp.float32
+    for lu in net.updater_state:
+        for st in lu.values():
+            for a in st.values():
+                assert a.dtype == jnp.float32
+    for lv in net.variables:
+        for a in lv.values():
+            assert a.dtype == jnp.float32
+    assert losses[-1] < losses[0]
+    # compute (activations) run in bf16
+    assert net.output(x[:4]).dtype == jnp.bfloat16
+
+
+def test_mixed_precision_tracks_f32_training():
+    x, y = _img_data(seed=1)
+    nets = {}
+    for cd in (None, "bfloat16"):
+        net = MultiLayerNetwork(_cnn_conf(cd)).init()
+        for _ in range(10):
+            net.fit(x, y)
+        nets[cd] = net.score_
+    # bf16 compute follows the f32 trajectory to within bf16 noise
+    assert abs(nets[None] - nets["bfloat16"]) < 0.1 * max(1.0, abs(nets[None]))
+
+
+def test_mixed_precision_graph_transformer():
+    conf = transformer_lm(vocab_size=13, d_model=16, n_heads=2, n_blocks=1)
+    conf.conf.compute_dtype = "bfloat16"
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 13, (4, 9))
+    eye = np.eye(13, dtype=np.float32)
+    x, y = eye[ids[:, :-1]], eye[ids[:, 1:]]
+    for _ in range(5):
+        net.fit([x], [y])
+    assert np.isfinite(net.score_)
+    for lp in net.params.values():
+        for a in lp.values():
+            assert a.dtype == jnp.float32
+
+
+def test_compute_dtype_serde_roundtrip():
+    conf = _cnn_conf("bfloat16")
+    rt = MultiLayerConfiguration.from_json(conf.to_json())
+    assert rt.conf.compute_dtype == "bfloat16"
+
+
+def test_unsupported_compute_dtype_raises():
+    import pytest
+    conf = _cnn_conf("float16")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        MultiLayerNetwork(conf).init().fit(*_img_data(n=8))
+
+
+def test_mixed_precision_tbptt_state_runs_bf16():
+    """TBPTT carried state follows the compute dtype, so the recurrent hot
+    loop actually runs in bf16 under mixed precision."""
+    from deeplearning4j_tpu.models.zoo import char_rnn_lstm
+    conf = char_rnn_lstm(vocab_size=11, hidden=8, tbptt=6)
+    conf.conf.compute_dtype = "bfloat16"
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(3)
+    eye = np.eye(11, dtype=np.float32)
+    ids = rng.integers(0, 11, (4, 13))
+    net.fit(eye[ids[:, :-1]], eye[ids[:, 1:]])
+    assert np.isfinite(net.score_)
+    for lp in net.params:
+        for a in lp.values():
+            assert a.dtype == jnp.float32
