@@ -6,11 +6,12 @@ Two halves, mirroring the reference's native layer (SURVEY.md §2.1):
   - `lib` — the compiled C++ data-path runtime (IDX/CSV decode, staging
     buffer pool) with NumPy fallback when no toolchain is present
 """
-from .ndarray import (Backend, JaxBackend, NDArray, Nd4j, Transforms,
-                      get_backend, set_backend)
+from .ndarray import (Backend, BooleanIndexing, Convolution, JaxBackend,
+                      NDArray, Nd4j, Transforms, get_backend, set_backend)
 from .lib import (StagingBuffer, decode_csv, decode_idx, native_available,
                   staging_stats)
 
-__all__ = ["Backend", "JaxBackend", "NDArray", "Nd4j", "Transforms",
+__all__ = ["Backend", "BooleanIndexing", "Convolution", "JaxBackend",
+           "NDArray", "Nd4j", "Transforms",
            "get_backend", "set_backend", "StagingBuffer", "decode_csv",
            "decode_idx", "native_available", "staging_stats"]

@@ -408,6 +408,76 @@ class Nd4j:
         return NDArray(jnp.concatenate([a._buf for a in arrays], axis=axis))
 
 
+class BooleanIndexing:
+    """Conditional replacement (reference org.nd4j.linalg.indexing
+    .BooleanIndexing, used by core at 5 sites): functional on immutable
+    buffers — `replace_where` returns the rebound handle like the `*i` ops."""
+
+    @staticmethod
+    def replace_where(arr: NDArray, value, cond) -> NDArray:
+        import jax.numpy as jnp
+        mask = cond(arr._buf) if callable(cond) else jnp.asarray(cond)
+        arr._buf = jnp.where(mask, jnp.asarray(value, arr._buf.dtype),
+                             arr._buf)
+        return arr
+
+    @staticmethod
+    def and_all(arr: NDArray, cond) -> bool:
+        import jax.numpy as jnp
+        mask = cond(arr._buf) if callable(cond) else jnp.asarray(cond)
+        return bool(jnp.all(mask))
+
+    @staticmethod
+    def or_all(arr: NDArray, cond) -> bool:
+        import jax.numpy as jnp
+        mask = cond(arr._buf) if callable(cond) else jnp.asarray(cond)
+        return bool(jnp.any(mask))
+
+
+class Convolution:
+    """im2col/col2im (reference org.nd4j.linalg.convolution.Convolution,
+    used by the reference conv layer's gemm formulation). The framework's
+    conv layers lower to XLA's native convolution instead; this surface
+    exists for reference-style user code and is XLA-lowered itself."""
+
+    @staticmethod
+    def im2col(img: NDArray, kh: int, kw: int, sy: int = 1, sx: int = 1,
+               ph: int = 0, pw: int = 0) -> NDArray:
+        """[N, C, H, W] -> [N, C, kh, kw, oh, ow] patch tensor."""
+        import jax.numpy as jnp
+        x = img._buf
+        n, c, h, w = x.shape
+        xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        oh = (h + 2 * ph - kh) // sy + 1
+        ow = (w + 2 * pw - kw) // sx + 1
+        if oh <= 0 or ow <= 0:
+            raise ValueError(
+                f"im2col: kernel ({kh}x{kw}) exceeds padded input "
+                f"({h + 2 * ph}x{w + 2 * pw})")
+        rows = jnp.stack([xp[:, :, i:i + sy * (oh - 1) + 1:sy, :]
+                          for i in range(kh)], axis=2)  # [N,C,kh,oh,W']
+        cols = jnp.stack([rows[:, :, :, :, j:j + sx * (ow - 1) + 1:sx]
+                          for j in range(kw)], axis=3)  # [N,C,kh,kw,oh,ow]
+        return NDArray(cols)
+
+    @staticmethod
+    def col2im(col: NDArray, sy: int, sx: int, ph: int, pw: int,
+               h: int, w: int) -> NDArray:
+        """Adjoint of im2col: scatter-add patches back to [N, C, H, W]."""
+        import jax
+        import jax.numpy as jnp
+        n, c, kh, kw, oh, ow = col._buf.shape
+
+        def fwd(img):
+            return Convolution.im2col(NDArray(img), kh, kw, sy, sx,
+                                      ph, pw)._buf
+        # im2col is linear: linear_transpose gives the adjoint without
+        # executing a throwaway forward pass (unlike jax.vjp)
+        t = jax.linear_transpose(
+            fwd, jax.ShapeDtypeStruct((n, c, h, w), col._buf.dtype))
+        return NDArray(t(col._buf)[0])
+
+
 def _norm_shape(shape) -> Tuple[int, ...]:
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         return tuple(shape[0])

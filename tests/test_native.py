@@ -168,3 +168,33 @@ def test_native_csv_rejects_empty_fields():
     # strict grammar still accepts padding whitespace
     np.testing.assert_allclose(decode_csv(b" 1 , 2 \n 3 , 4 \n"),
                                [[1, 2], [3, 4]])
+
+
+def test_boolean_indexing():
+    from deeplearning4j_tpu.native.ndarray import BooleanIndexing, Nd4j
+    a = Nd4j.create([-2.0, 1.0, -0.5, 3.0])
+    BooleanIndexing.replace_where(a, 0.0, lambda x: x < 0)
+    np.testing.assert_allclose(a.to_numpy(), [0.0, 1.0, 0.0, 3.0])
+    assert BooleanIndexing.and_all(a, lambda x: x >= 0)
+    assert BooleanIndexing.or_all(a, lambda x: x > 2)
+
+
+def test_im2col_col2im_adjoint():
+    from deeplearning4j_tpu.native.ndarray import Convolution, Nd4j
+    rng = np.random.default_rng(0)
+    img = Nd4j.create(rng.normal(size=(2, 3, 6, 6)).astype(np.float32),
+                      shape=None)
+    col = Convolution.im2col(img, 3, 3, 1, 1, 1, 1)
+    assert col.shape == (2, 3, 3, 3, 6, 6)
+    # patch content check: center patch equals the raw window
+    x = img.to_numpy()
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    np.testing.assert_allclose(col.to_numpy()[0, 0, :, :, 2, 2],
+                               xp[0, 0, 2:5, 2:5])
+    # col2im is the exact adjoint: <im2col(x), c> == <x, col2im(c)>
+    c = rng.normal(size=col.shape).astype(np.float32)
+    from deeplearning4j_tpu.native.ndarray import NDArray
+    back = Convolution.col2im(NDArray(np.asarray(c)), 1, 1, 1, 1, 6, 6)
+    lhs = float((col.to_numpy() * c).sum())
+    rhs = float((x * back.to_numpy()).sum())
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-4)
