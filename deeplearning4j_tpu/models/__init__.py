@@ -1,5 +1,6 @@
 """Model zoo: the reference benchmark configurations plus the long-context
 transformer this framework adds (see ``models/zoo.py``)."""
+from .sampling import generate_rnn, generate_transformer
 from .zoo import (alexnet_cifar10, char_rnn_lstm, dbn_mnist,
                   deep_autoencoder_mnist, lenet_mnist, mlp_iris,
                   transformer_lm)
@@ -7,4 +8,5 @@ from .zoo import (alexnet_cifar10, char_rnn_lstm, dbn_mnist,
 __all__ = [
     "alexnet_cifar10", "char_rnn_lstm", "dbn_mnist",
     "deep_autoencoder_mnist", "lenet_mnist", "mlp_iris", "transformer_lm",
+    "generate_rnn", "generate_transformer",
 ]

@@ -1,0 +1,80 @@
+"""Autoregressive generation utilities for the language models.
+
+No reference counterpart (the 0.4-era codebase predates LM sampling); the
+char-RNN example's greedy loop (reference-era GravesLSTM demos sample this
+way) generalized to temperature / top-k sampling for both the stateful
+recurrent nets (`rnn_time_step`) and the transformer ComputationGraph
+(full-context re-forward per token).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def _sample_logits(probs: np.ndarray, temperature: float, top_k: Optional[int],
+                   rng: np.random.Generator) -> int:
+    """Pick a token id from one probability row [V]."""
+    if temperature <= 0.0:  # greedy
+        return int(probs.argmax())
+    logits = np.log(np.maximum(probs, 1e-30)) / temperature
+    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        cutoff = np.partition(logits, -top_k)[-top_k]
+        logits = np.where(logits >= cutoff, logits, -np.inf)
+    logits = logits - logits.max()
+    p = np.exp(logits)
+    p /= p.sum()
+    return int(rng.choice(p.shape[-1], p=p))
+
+
+def generate_transformer(net, prompt_ids: Sequence[int], n_tokens: int,
+                         vocab_size: int, *, temperature: float = 0.0,
+                         top_k: Optional[int] = None, seed: int = 0,
+                         max_context: Optional[int] = None) -> list:
+    """Continue `prompt_ids` by `n_tokens` using a transformer_lm
+    ComputationGraph (one-hot input, next-token distribution per step).
+    Re-forwards the full (optionally truncated) context per token."""
+    if not len(prompt_ids):
+        raise ValueError("prompt_ids must be non-empty (the model needs at "
+                         "least one token of context)")
+    rng = np.random.default_rng(seed)
+    ids = list(int(i) for i in prompt_ids)
+    out = []
+    for _ in range(n_tokens):
+        ctx = np.asarray(ids if max_context is None else ids[-max_context:])
+        x = np.zeros((1, len(ctx), vocab_size), np.float32)  # O(T*V), not
+        x[0, np.arange(len(ctx)), ctx] = 1.0                 # an eye(V)
+        probs = np.asarray(net.output(x)[0])[0, -1]
+        nxt = _sample_logits(probs, temperature, top_k, rng)
+        ids.append(nxt)
+        out.append(nxt)
+    return out
+
+
+def generate_rnn(net, prompt_ids: Sequence[int], n_tokens: int,
+                 vocab_size: int, *, temperature: float = 0.0,
+                 top_k: Optional[int] = None, seed: int = 0) -> list:
+    """Continue `prompt_ids` by `n_tokens` with a recurrent
+    MultiLayerNetwork via stateful O(1)-memory `rnn_time_step`
+    (reference rnnTimeStep:1460 streaming inference)."""
+    if not len(prompt_ids):
+        raise ValueError("prompt_ids must be non-empty (the model needs at "
+                         "least one token of context)")
+    rng = np.random.default_rng(seed)
+    net.rnn_clear_previous_state()
+
+    def step(tok):
+        x = np.zeros((1, 1, vocab_size), np.float32)
+        x[0, 0, int(tok)] = 1.0
+        return np.asarray(net.rnn_time_step(x))
+
+    for tok in prompt_ids:  # prime the state one step at a time
+        probs = step(tok)
+    out = []
+    for _ in range(n_tokens):
+        row = probs[0, -1] if probs.ndim == 3 else probs[0]
+        nxt = _sample_logits(row, temperature, top_k, rng)
+        out.append(nxt)
+        probs = step(nxt)
+    return out
