@@ -315,6 +315,9 @@ class SelfAttentionLayer(FeedForwardLayer):
 
     n_heads: int = 4
     causal: bool = False
+    # KV-cache capacity for stateful streaming inference (rnn_time_step);
+    # decoding past this many positions is unsupported
+    max_cache_len: int = 1024
 
     def get_output_type(self, input_type: InputType) -> InputType:
         ts = input_type.timesteps if isinstance(input_type, RecurrentInputType) else None

@@ -520,10 +520,10 @@ class ComputationGraph:
         batch = inputs[0].shape[0]
         # state dtype = the network compute dtype (NOT input[0].dtype:
         # the first input may be integer embedding indices)
-        dtype = _compute_dtype_of(self.conf.conf)
-        states = {name: impl.init_state(batch, dtype)
-                  for name, impl in self._impls.items()
-                  if isinstance(impl, BaseRecurrentImpl)}
+        from .multilayer import _materialize_rnn_states
+        states = _materialize_rnn_states(
+            self._impls.items(), {}, batch,
+            _compute_dtype_of(self.conf.conf), tbptt=True)
         key = ("tbptt_step",)
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(self._build_train_step_stateful(),
@@ -671,9 +671,16 @@ class ComputationGraph:
             if a.ndim == 2:
                 a = a[:, None, :]
             ins.append(a)
+        # materialize initial states so stateful-only machinery (e.g. the
+        # attention KV cache) engages from the first call (see
+        # MultiLayerNetwork.rnn_time_step)
+        from .multilayer import _materialize_rnn_states
+        states = _materialize_rnn_states(
+            self._impls.items(), self._rnn_state, ins[0].shape[0],
+            _compute_dtype_of(self.conf.conf))
         acts, _, new_states = self._forward_impl(
             self.params, self.variables, ins, train=False, rng=None,
-            states=self._rnn_state or None)
+            states=states)
         self._rnn_state = new_states
         return [acts[name] for name in self.conf.network_outputs]
 

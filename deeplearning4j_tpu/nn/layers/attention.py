@@ -4,6 +4,13 @@ No reference counterpart (pre-transformer codebase — SURVEY.md §5); added as
 the long-context-capable layer of this framework. Under a `pjit`/GSPMD mesh
 the dense path shards automatically; for explicit sequence parallelism use
 `parallel.ring.ring_attention` / `ulysses_attention` (same math, tested equal).
+
+Streaming inference: the impl extends the recurrent-state protocol
+(BaseRecurrentImpl), carrying a fixed-capacity KV cache as its state — so
+`rnn_time_step` (reference rnnTimeStep:1460, O(1)-memory streaming) works
+for transformers exactly like for LSTMs: O(L_max) per token instead of
+re-forwarding the full context. Training always runs the full-sequence
+path; the cache exists only on the inference step path.
 """
 from __future__ import annotations
 
@@ -11,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .base import LayerImpl, register_impl
+from .recurrent import BaseRecurrentImpl
 from .. import weights as winit
 from ...ops import helpers as ophelpers
 
@@ -18,8 +26,10 @@ Array = jax.Array
 
 
 @register_impl("SelfAttentionLayer")
-class SelfAttentionLayerImpl(LayerImpl):
+class SelfAttentionLayerImpl(BaseRecurrentImpl):
     WEIGHT_KEYS = ("Wq", "Wk", "Wv", "Wo")
+    TBPTT_STATE = False  # the KV cache is inference-only state; training
+    # always runs the full-sequence path (no cross-window carry)
 
     def init_params(self, key, dtype=jnp.float32):
         conf = self.conf
@@ -36,22 +46,79 @@ class SelfAttentionLayerImpl(LayerImpl):
             "b": jnp.full((model,), float(conf.bias_init or 0.0), dtype),
         }
 
-    def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+    # -- recurrent-state protocol (KV cache) ----------------------------------
+    def init_state(self, batch: int, dtype=jnp.float32):
         conf = self.conf
-        x = self._dropout(x, train, rng)
+        H = conf.n_heads
+        Dh = conf.n_out // H
+        L = int(getattr(conf, "max_cache_len", 1024))
+        return {"k": jnp.zeros((batch, L, H, Dh), dtype),
+                "v": jnp.zeros((batch, L, H, Dh), dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    def _qkv(self, params, x):
+        conf = self.conf
         B, T, _ = x.shape
         H = conf.n_heads
         Dh = conf.n_out // H
 
-        def split(a):
-            return a.reshape(B, T, H, Dh)
+        def proj(w):
+            return jnp.einsum("btf,fo->bto", x, params[w]).reshape(B, T, H, Dh)
 
-        q = split(jnp.einsum("btf,fo->bto", x, params["Wq"]))
-        k = split(jnp.einsum("btf,fo->bto", x, params["Wk"]))
-        v = split(jnp.einsum("btf,fo->bto", x, params["Wv"]))
+        return proj("Wq"), proj("Wk"), proj("Wv")
+
+    def _out(self, params, o, B, T):
+        out = jnp.einsum("btm,mn->btn", o.reshape(B, T, self.conf.n_out),
+                         params["Wo"]) + params["b"]
+        return self.activation_fn()(out)
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        conf = self.conf
+        x = self._dropout(x, train, rng)
+        B, T, _ = x.shape
+        q, k, v = self._qkv(params, x)
         o = ophelpers.attention(q, k, v, causal=conf.causal)
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
-        out = jnp.einsum("btm,mn->btn", o.reshape(B, T, conf.n_out),
-                         params["Wo"]) + params["b"]
-        return self.activation_fn()(out), variables or {}
+        return self._out(params, o, B, T), variables or {}
+
+    def forward_with_state(self, params, x, state0, *, train=False, rng=None,
+                           mask=None):
+        """Full-sequence attention when training or uncached (state passes
+        through untouched); KV-cached incremental attention when an
+        inference step arrives with a cache state. Positions beyond
+        `max_cache_len` are unsupported (fixed-capacity cache)."""
+        if train or state0 is None:
+            y, _ = self.forward(params, x, train=train, rng=rng, mask=mask)
+            return y, state0
+        if not self.conf.causal:
+            raise NotImplementedError(
+                "KV-cached streaming decode requires causal=True: a "
+                "non-causal layer's full forward attends to FUTURE "
+                "positions the cache cannot know yet (same limitation as "
+                "bidirectional LSTM rnnTimeStep)")
+        B, T, _ = x.shape
+        Dh = self.conf.n_out // self.conf.n_heads
+        pos = state0["pos"]
+        L_cap = state0["k"].shape[1]
+        if not isinstance(pos, jax.core.Tracer) and int(pos) + T > L_cap:
+            raise ValueError(
+                f"KV cache overflow: position {int(pos)}+{T} exceeds "
+                f"max_cache_len={L_cap}; raise SelfAttentionLayer."
+                f"max_cache_len or rnn_clear_previous_state()")
+        q, k_new, v_new = self._qkv(params, x)
+        kc = jax.lax.dynamic_update_slice(state0["k"], k_new, (0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(state0["v"], v_new, (0, pos, 0, 0))
+        L = kc.shape[1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) / jnp.sqrt(
+            jnp.asarray(Dh, q.dtype))
+        kpos = jnp.arange(L)[None, :]
+        qpos = pos + jnp.arange(T)[:, None]
+        valid = kpos <= qpos
+        s = jnp.where(valid[None, None], s.astype(jnp.float32), -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, vc)
+        if mask is not None:
+            o = o * mask[:, :, None, None].astype(o.dtype)
+        y = self._out(params, o, B, T)
+        return y, {"k": kc, "v": vc, "pos": pos + T}

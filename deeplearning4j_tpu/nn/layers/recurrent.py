@@ -32,6 +32,9 @@ State = Dict[str, Array]
 
 class BaseRecurrentImpl(LayerImpl):
     WEIGHT_KEYS = ("W", "RW")
+    # whether TBPTT carries this impl's state across windows (true RNN
+    # state; the attention KV cache opts out — it is inference-only)
+    TBPTT_STATE = True
 
     def init_state(self, batch: int, dtype=jnp.float32) -> State:
         raise NotImplementedError

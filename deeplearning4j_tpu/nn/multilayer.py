@@ -65,6 +65,23 @@ def _cast_floats(tree, dtype):
         if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a, tree)
 
 
+def _materialize_rnn_states(impl_items, existing, batch, dtype, *,
+                            tbptt=False):
+    """Initial states for stateful layers: existing entries are kept, the
+    rest are init_state'd. ``tbptt`` restricts to impls whose state TBPTT
+    carries across windows (excludes the inference-only attention KV cache).
+    Shared by both facades' rnn_time_step and _do_truncated_bptt."""
+    states = dict(existing or {})
+    for key, impl in impl_items:
+        if not isinstance(impl, BaseRecurrentImpl):
+            continue
+        if tbptt and not impl.TBPTT_STATE:
+            continue
+        if states.get(key) is None:
+            states[key] = impl.init_state(batch, dtype)
+    return states
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -533,10 +550,9 @@ class MultiLayerNetwork:
         y = jnp.asarray(y)
         T = x.shape[1]
         L = self.conf.tbptt_fwd_length
-        states = {i: impl.init_state(x.shape[0],
-                                     _compute_dtype_of(self.conf.conf))
-                  for i, impl in enumerate(self._impls)
-                  if isinstance(impl, BaseRecurrentImpl)}
+        states = _materialize_rnn_states(
+            enumerate(self._impls), {}, x.shape[0],
+            _compute_dtype_of(self.conf.conf), tbptt=True)
         start = 0
         while start < T:
             end = min(start + L, T)
@@ -675,9 +691,15 @@ class MultiLayerNetwork:
         x = jnp.asarray(x)
         if x.ndim == 2:
             x = x[:, None, :]
+        # materialize initial states so stateful-only machinery (e.g. the
+        # attention KV cache) engages from the first call; plain output()
+        # (states=None) keeps the stateless full path
+        states = _materialize_rnn_states(
+            enumerate(self._impls), self._rnn_state, x.shape[0],
+            _compute_dtype_of(self.conf.conf))
         acts, _, new_states = self._forward_impl(
             self.params, self.variables, x, train=False, rng=None,
-            states=self._rnn_state or None)
+            states=states)
         self._rnn_state = new_states
         return acts[-1]
 

@@ -1,0 +1,131 @@
+"""KV-cache streaming inference for transformers via rnn_time_step.
+
+The attention impl carries a fixed-capacity KV cache through the SAME
+recurrent-state protocol LSTMs use (reference rnnTimeStep:1460), so
+incremental decode is O(cache) per token instead of re-forwarding the
+full context. Golden check: token-by-token outputs == full-context
+forward outputs.
+"""
+import numpy as np
+
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _net(v=13, cache=64):
+    conf = transformer_lm(vocab_size=v, d_model=16, n_heads=2, n_blocks=2)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = cache
+    return ComputationGraph(conf).init()
+
+
+def test_incremental_decode_matches_full_forward():
+    V, T, B = 13, 10, 3
+    net = _net(V)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, (B, T))
+    eye = np.eye(V, dtype=np.float32)
+    x = eye[ids]
+    full = np.asarray(net.output(x)[0])  # [B, T, V]
+
+    net.rnn_clear_previous_state()
+    for t in range(T):
+        step_out = np.asarray(net.rnn_time_step(x[:, t:t + 1])[0])
+        np.testing.assert_allclose(step_out[:, 0], full[:, t],
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=f"timestep {t}")
+
+
+def test_chunked_decode_matches_full_forward():
+    """Multi-token chunks through the cache (prefill + decode pattern)."""
+    V, T, B = 13, 12, 2
+    net = _net(V)
+    rng = np.random.default_rng(1)
+    x = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    full = np.asarray(net.output(x)[0])
+
+    net.rnn_clear_previous_state()
+    prefill = np.asarray(net.rnn_time_step(x[:, :8])[0])   # chunk of 8
+    np.testing.assert_allclose(prefill, full[:, :8], rtol=2e-5, atol=2e-6)
+    rest = np.asarray(net.rnn_time_step(x[:, 8:])[0])      # chunk of 4
+    np.testing.assert_allclose(rest, full[:, 8:], rtol=2e-5, atol=2e-6)
+
+
+def test_cache_state_resets():
+    V = 13
+    net = _net(V)
+    rng = np.random.default_rng(2)
+    x = np.eye(V, dtype=np.float32)[rng.integers(0, V, (2, 5))]
+    net.rnn_clear_previous_state()
+    a = np.asarray(net.rnn_time_step(x)[0])
+    net.rnn_clear_previous_state()
+    b = np.asarray(net.rnn_time_step(x)[0])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_generation_uses_cache_equals_full_reforward():
+    from deeplearning4j_tpu.models.sampling import generate_transformer
+    V = 11
+    net = _net(V)
+    rng = np.random.default_rng(3)
+    ids = (rng.integers(0, V, 4)[:, None] + np.arange(9)[None]) % V
+    eye = np.eye(V, dtype=np.float32)
+    for _ in range(40):
+        net.fit([eye[ids[:, :-1]]], [eye[ids[:, 1:]]])
+    full_toks = generate_transformer(net, [3, 4, 5], 5, V)
+    # cached greedy decode token by token
+    net.rnn_clear_previous_state()
+    probs = np.asarray(net.rnn_time_step(eye[[3, 4, 5]][None])[0])[0, -1]
+    cached = []
+    for _ in range(5):
+        nxt = int(probs.argmax())
+        cached.append(nxt)
+        probs = np.asarray(net.rnn_time_step(eye[[nxt]][None])[0])[0, -1]
+    assert cached == full_toks
+
+
+def test_noncausal_streaming_raises():
+    import pytest
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import (GlobalPoolingLayer,
+                                                   OutputLayer,
+                                                   SelfAttentionLayer)
+    conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.01)
+            .list()
+            .layer(SelfAttentionLayer(n_in=6, n_out=8, n_heads=2,
+                                      causal=False, activation="identity"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 4, 6)).astype(np.float32)
+    _ = net.output(x)  # full path fine
+    with pytest.raises(NotImplementedError, match="causal"):
+        net.rnn_time_step(x)
+
+
+def test_cache_overflow_raises():
+    import pytest
+    net = _net(cache=8)
+    x = np.eye(13, dtype=np.float32)[np.zeros((1, 6), int)]
+    net.rnn_clear_previous_state()
+    net.rnn_time_step(x)  # pos -> 6
+    with pytest.raises(ValueError, match="overflow"):
+        net.rnn_time_step(x)  # 6 + 6 > 8
+
+
+def test_tbptt_state_excludes_kv_cache():
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayerImpl
+    from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentImpl
+    from deeplearning4j_tpu.nn.multilayer import _materialize_rnn_states
+    from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+    impl = SelfAttentionLayerImpl(SelfAttentionLayer(n_in=4, n_out=8,
+                                                     n_heads=2, causal=True))
+    assert isinstance(impl, BaseRecurrentImpl)
+    full = _materialize_rnn_states([("a", impl)], {}, 2, np.float32)
+    assert "a" in full                       # streaming decode gets a cache
+    tb = _materialize_rnn_states([("a", impl)], {}, 2, np.float32, tbptt=True)
+    assert "a" not in tb                     # TBPTT does not allocate one
