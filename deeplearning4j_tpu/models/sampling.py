@@ -31,21 +31,44 @@ def _sample_logits(probs: np.ndarray, temperature: float, top_k: Optional[int],
 def generate_transformer(net, prompt_ids: Sequence[int], n_tokens: int,
                          vocab_size: int, *, temperature: float = 0.0,
                          top_k: Optional[int] = None, seed: int = 0,
-                         max_context: Optional[int] = None) -> list:
+                         max_context: Optional[int] = None,
+                         use_cache: bool = False) -> list:
     """Continue `prompt_ids` by `n_tokens` using a transformer_lm
     ComputationGraph (one-hot input, next-token distribution per step).
-    Re-forwards the full (optionally truncated) context per token."""
+
+    use_cache=False re-forwards the full (optionally truncated) context per
+    token; use_cache=True streams through the attention KV cache
+    (`rnn_time_step`: prefill the prompt once, then O(cache) per token —
+    requires causal attention and prompt+tokens <= max_cache_len)."""
     if not len(prompt_ids):
         raise ValueError("prompt_ids must be non-empty (the model needs at "
                          "least one token of context)")
+    if use_cache and max_context is not None:
+        raise ValueError("max_context (sliding window) is not supported "
+                         "with use_cache=True: the KV cache never evicts; "
+                         "use the re-forward path for windowed generation")
     rng = np.random.default_rng(seed)
-    ids = list(int(i) for i in prompt_ids)
-    out = []
-    for _ in range(n_tokens):
-        ctx = np.asarray(ids if max_context is None else ids[-max_context:])
+
+    def onehot(ctx):
+        ctx = np.asarray(ctx, dtype=np.int64)
         x = np.zeros((1, len(ctx), vocab_size), np.float32)  # O(T*V), not
         x[0, np.arange(len(ctx)), ctx] = 1.0                 # an eye(V)
-        probs = np.asarray(net.output(x)[0])[0, -1]
+        return x
+
+    out = []
+    if use_cache:
+        net.rnn_clear_previous_state()
+        probs = np.asarray(
+            net.rnn_time_step(onehot(prompt_ids))[0])[0, -1]
+        for _ in range(n_tokens):
+            nxt = _sample_logits(probs, temperature, top_k, rng)
+            out.append(nxt)
+            probs = np.asarray(net.rnn_time_step(onehot([nxt]))[0])[0, -1]
+        return out
+    ids = list(int(i) for i in prompt_ids)
+    for _ in range(n_tokens):
+        ctx = np.asarray(ids if max_context is None else ids[-max_context:])
+        probs = np.asarray(net.output(onehot(ctx))[0])[0, -1]
         nxt = _sample_logits(probs, temperature, top_k, rng)
         ids.append(nxt)
         out.append(nxt)

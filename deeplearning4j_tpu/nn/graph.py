@@ -27,7 +27,8 @@ from .conf.graph import (ComputationGraphConfiguration,
                          SubsetVertex)
 from .conf.layers import OutputLayer, RnnOutputLayer, LossLayer
 from .layers.base import LayerImpl, impl_for, remat_forward
-from .layers.recurrent import BaseRecurrentImpl
+from .layers.recurrent import (BaseRecurrentImpl,
+                               _materialize_rnn_states)
 from .conf.config import BACKPROP_TBPTT
 from .multilayer import _cast_floats, _compute_dtype_of, _dtype_of
 from .updater.gradnorm import apply_gradient_normalization
@@ -520,7 +521,6 @@ class ComputationGraph:
         batch = inputs[0].shape[0]
         # state dtype = the network compute dtype (NOT input[0].dtype:
         # the first input may be integer embedding indices)
-        from .multilayer import _materialize_rnn_states
         states = _materialize_rnn_states(
             self._impls.items(), {}, batch,
             _compute_dtype_of(self.conf.conf), tbptt=True)
@@ -674,7 +674,6 @@ class ComputationGraph:
         # materialize initial states so stateful-only machinery (e.g. the
         # attention KV cache) engages from the first call (see
         # MultiLayerNetwork.rnn_time_step)
-        from .multilayer import _materialize_rnn_states
         states = _materialize_rnn_states(
             self._impls.items(), self._rnn_state, ins[0].shape[0],
             _compute_dtype_of(self.conf.conf))

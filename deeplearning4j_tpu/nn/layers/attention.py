@@ -106,6 +106,9 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
                 f"KV cache overflow: position {int(pos)}+{T} exceeds "
                 f"max_cache_len={L_cap}; raise SelfAttentionLayer."
                 f"max_cache_len or rnn_clear_previous_state()")
+        # under a trace pos is abstract and cannot raise; poison the output
+        # with NaN instead of silently reading a clamp-corrupted cache
+        overflow = (pos + T) > L_cap
         q, k_new, v_new = self._qkv(params, x)
         kc = jax.lax.dynamic_update_slice(state0["k"], k_new, (0, pos, 0, 0))
         vc = jax.lax.dynamic_update_slice(state0["v"], v_new, (0, pos, 0, 0))
@@ -121,4 +124,5 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
         y = self._out(params, o, B, T)
+        y = jnp.where(overflow, jnp.asarray(jnp.nan, y.dtype), y)
         return y, {"k": kc, "v": vc, "pos": pos + T}

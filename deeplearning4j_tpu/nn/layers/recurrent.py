@@ -56,6 +56,23 @@ class BaseRecurrentImpl(LayerImpl):
         return {k: m_t * new_state[k] + (1.0 - m_t) * old_state[k] for k in new_state}
 
 
+def _materialize_rnn_states(impl_items, existing, batch, dtype, *,
+                            tbptt=False):
+    """Initial states for stateful layers: existing entries are kept, the
+    rest are init_state'd. ``tbptt`` restricts to impls whose state TBPTT
+    carries across windows (excludes the inference-only attention KV cache).
+    Shared by both facades' rnn_time_step and _do_truncated_bptt."""
+    states = dict(existing or {})
+    for key, impl in impl_items:
+        if not isinstance(impl, BaseRecurrentImpl):
+            continue
+        if tbptt and not impl.TBPTT_STATE:
+            continue
+        if states.get(key) is None:
+            states[key] = impl.init_state(batch, dtype)
+    return states
+
+
 def _init_gate_weights(key, conf, n_gates: int, dtype, forget_slot: Optional[int] = None):
     conf_dist = conf.dist.spec() if getattr(conf, "dist", None) is not None else None
     k1, k2 = jax.random.split(key)

@@ -30,7 +30,8 @@ from .conf.preprocessors import (CnnToRnnPreProcessor,
                                  FeedForwardToRnnPreProcessor)
 from .layers.base import LayerImpl, impl_for, remat_forward
 from .layers.pretrain import AutoEncoderImpl, RBMImpl
-from .layers.recurrent import BaseRecurrentImpl
+from .layers.recurrent import (BaseRecurrentImpl,
+                               _materialize_rnn_states)
 from .updater.gradnorm import apply_gradient_normalization
 from .updater.schedules import effective_lr
 from ..ops import losses as losses_mod
@@ -63,23 +64,6 @@ def _cast_floats(tree, dtype):
     return jax.tree_util.tree_map(
         lambda a: a.astype(dtype)
         if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a, tree)
-
-
-def _materialize_rnn_states(impl_items, existing, batch, dtype, *,
-                            tbptt=False):
-    """Initial states for stateful layers: existing entries are kept, the
-    rest are init_state'd. ``tbptt`` restricts to impls whose state TBPTT
-    carries across windows (excludes the inference-only attention KV cache).
-    Shared by both facades' rnn_time_step and _do_truncated_bptt."""
-    states = dict(existing or {})
-    for key, impl in impl_items:
-        if not isinstance(impl, BaseRecurrentImpl):
-            continue
-        if tbptt and not impl.TBPTT_STATE:
-            continue
-        if states.get(key) is None:
-            states[key] = impl.init_state(batch, dtype)
-    return states
 
 
 class MultiLayerNetwork:

@@ -56,3 +56,12 @@ def test_rnn_generation_continues_cycle():
         net.fit(x, y)
     toks = generate_rnn(net, [2, 3, 4], 5, V)
     assert toks == [(4 + k) % V for k in range(1, 6)]
+
+
+def test_use_cache_rejects_max_context():
+    import pytest
+    net = ComputationGraph(transformer_lm(vocab_size=7, d_model=8,
+                                          n_heads=2, n_blocks=1)).init()
+    with pytest.raises(ValueError, match="max_context"):
+        generate_transformer(net, [1, 2], 3, 7, max_context=4,
+                             use_cache=True)
