@@ -318,6 +318,11 @@ class SelfAttentionLayer(FeedForwardLayer):
     # KV-cache capacity for stateful streaming inference (rnn_time_step);
     # decoding past this many positions is unsupported
     max_cache_len: int = 1024
+    # rotary position embeddings (RoPE): inject absolute position by
+    # rotating q/k per head-dim pair — no parameters, exact under the KV
+    # cache, the standard long-context encoding
+    rope: bool = False
+    rope_base: float = 10000.0
 
     def get_output_type(self, input_type: InputType) -> InputType:
         ts = input_type.timesteps if isinstance(input_type, RecurrentInputType) else None

@@ -56,7 +56,7 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
                 "v": jnp.zeros((batch, L, H, Dh), dtype),
                 "pos": jnp.zeros((), jnp.int32)}
 
-    def _qkv(self, params, x):
+    def _qkv(self, params, x, pos0=0):
         conf = self.conf
         B, T, _ = x.shape
         H = conf.n_heads
@@ -65,7 +65,30 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         def proj(w):
             return jnp.einsum("btf,fo->bto", x, params[w]).reshape(B, T, H, Dh)
 
-        return proj("Wq"), proj("Wk"), proj("Wv")
+        q, k, v = proj("Wq"), proj("Wk"), proj("Wv")
+        if getattr(conf, "rope", False):
+            q = self._rope(q, pos0)
+            k = self._rope(k, pos0)
+        return q, k, v
+
+    def _rope(self, a, pos0):
+        """Rotary position embedding on [B, T, H, Dh] (Dh even), half-split
+        pairing (GPT-NeoX "rotate-half" convention: dim i pairs with
+        i + Dh/2 — NOT the paper's interleaved (0,1),(2,3) pairing; weight
+        converters must match). The rotation commutes with the KV cache —
+        cached keys are stored pre-rotated at their absolute position."""
+        B, T, H, Dh = a.shape
+        if Dh % 2:
+            raise ValueError(f"rope requires an even head dim, got {Dh}")
+        half = Dh // 2
+        freq = jnp.asarray(self.conf.rope_base, jnp.float32) ** (
+            -jnp.arange(half, dtype=jnp.float32) / half)
+        ang = (pos0 + jnp.arange(T, dtype=jnp.float32))[:, None] * freq[None]
+        cos = jnp.cos(ang)[None, :, None, :].astype(a.dtype)
+        sin = jnp.sin(ang)[None, :, None, :].astype(a.dtype)
+        a1, a2 = a[..., :half], a[..., half:]
+        return jnp.concatenate([a1 * cos - a2 * sin,
+                                a1 * sin + a2 * cos], axis=-1)
 
     def _out(self, params, o, B, T):
         out = jnp.einsum("btm,mn->btn", o.reshape(B, T, self.conf.n_out),
@@ -101,6 +124,7 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         Dh = self.conf.n_out // self.conf.n_heads
         pos = state0["pos"]
         L_cap = state0["k"].shape[1]
+        del rng  # no dropout on the inference step path
         if not isinstance(pos, jax.core.Tracer) and int(pos) + T > L_cap:
             raise ValueError(
                 f"KV cache overflow: position {int(pos)}+{T} exceeds "
@@ -109,7 +133,7 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         # under a trace pos is abstract and cannot raise; poison the output
         # with NaN instead of silently reading a clamp-corrupted cache
         overflow = (pos + T) > L_cap
-        q, k_new, v_new = self._qkv(params, x)
+        q, k_new, v_new = self._qkv(params, x, pos0=pos)
         kc = jax.lax.dynamic_update_slice(state0["k"], k_new, (0, pos, 0, 0))
         vc = jax.lax.dynamic_update_slice(state0["v"], v_new, (0, pos, 0, 0))
         L = kc.shape[1]
