@@ -323,6 +323,10 @@ class SelfAttentionLayer(FeedForwardLayer):
     # cache, the standard long-context encoding
     rope: bool = False
     rope_base: float = 10000.0
+    # grouped-query attention: K/V projected to this many heads (must
+    # divide n_heads); shrinks the KV projections and the decode cache by
+    # n_heads/n_kv_heads. None = multi-head (n_kv_heads == n_heads)
+    n_kv_heads: Optional[int] = None
 
     def get_output_type(self, input_type: InputType) -> InputType:
         ts = input_type.timesteps if isinstance(input_type, RecurrentInputType) else None

@@ -31,17 +31,30 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
     TBPTT_STATE = False  # the KV cache is inference-only state; training
     # always runs the full-sequence path (no cross-window carry)
 
+    def _kv_heads(self) -> int:
+        """K/V head count: n_kv_heads (grouped-query attention) or n_heads
+        (plain multi-head). Must divide n_heads."""
+        conf = self.conf
+        kv = getattr(conf, "n_kv_heads", None)
+        if kv is None:
+            return conf.n_heads
+        if kv <= 0 or conf.n_heads % kv:
+            raise ValueError(f"n_kv_heads={kv} must be a positive divisor "
+                             f"of n_heads={conf.n_heads}")
+        return kv
+
     def init_params(self, key, dtype=jnp.float32):
         conf = self.conf
         dist = conf.dist.spec() if getattr(conf, "dist", None) is not None else None
         kq, kk, kv, ko = jax.random.split(key, 4)
         model = conf.n_out
+        kv_dim = self._kv_heads() * (model // conf.n_heads)
         mk = lambda k, i, o: winit.init_weights(k, (i, o), conf.weight_init or "xavier",
                                                 dist, dtype)
         return {
             "Wq": mk(kq, conf.n_in, model),
-            "Wk": mk(kk, conf.n_in, model),
-            "Wv": mk(kv, conf.n_in, model),
+            "Wk": mk(kk, conf.n_in, kv_dim),
+            "Wv": mk(kv, conf.n_in, kv_dim),
             "Wo": mk(ko, model, model),
             "b": jnp.full((model,), float(conf.bias_init or 0.0), dtype),
         }
@@ -49,27 +62,42 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
     # -- recurrent-state protocol (KV cache) ----------------------------------
     def init_state(self, batch: int, dtype=jnp.float32):
         conf = self.conf
-        H = conf.n_heads
-        Dh = conf.n_out // H
+        Dh = conf.n_out // conf.n_heads
+        Hkv = self._kv_heads()  # GQA: the cache shrinks with the KV heads
         L = int(getattr(conf, "max_cache_len", 1024))
-        return {"k": jnp.zeros((batch, L, H, Dh), dtype),
-                "v": jnp.zeros((batch, L, H, Dh), dtype),
+        return {"k": jnp.zeros((batch, L, Hkv, Dh), dtype),
+                "v": jnp.zeros((batch, L, Hkv, Dh), dtype),
                 "pos": jnp.zeros((), jnp.int32)}
 
     def _qkv(self, params, x, pos0=0):
+        """Projections as [B, T, heads, Dh]; K/V carry `n_kv_heads` heads
+        (NOT yet broadcast to the query heads — the cache stores them
+        compact; `_expand_kv` broadcasts at attention time)."""
         conf = self.conf
         B, T, _ = x.shape
         H = conf.n_heads
         Dh = conf.n_out // H
 
-        def proj(w):
-            return jnp.einsum("btf,fo->bto", x, params[w]).reshape(B, T, H, Dh)
+        def proj(w, heads):
+            return jnp.einsum("btf,fo->bto", x, params[w]).reshape(
+                B, T, heads, Dh)
 
-        q, k, v = proj("Wq"), proj("Wk"), proj("Wv")
+        Hkv = self._kv_heads()
+        q = proj("Wq", H)
+        k = proj("Wk", Hkv)
+        v = proj("Wv", Hkv)
         if getattr(conf, "rope", False):
             q = self._rope(q, pos0)
             k = self._rope(k, pos0)
         return q, k, v
+
+    def _expand_kv(self, a):
+        """Broadcast [B, T, Hkv, Dh] K/V to the n_heads query heads."""
+        H = self.conf.n_heads
+        Hkv = a.shape[2]
+        if Hkv == H:
+            return a
+        return jnp.repeat(a, H // Hkv, axis=2)
 
     def _rope(self, a, pos0):
         """Rotary position embedding on [B, T, H, Dh] (Dh even), half-split
@@ -100,7 +128,8 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         x = self._dropout(x, train, rng)
         B, T, _ = x.shape
         q, k, v = self._qkv(params, x)
-        o = ophelpers.attention(q, k, v, causal=conf.causal)
+        o = ophelpers.attention(q, self._expand_kv(k), self._expand_kv(v),
+                                causal=conf.causal)
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
         return self._out(params, o, B, T), variables or {}
@@ -137,14 +166,20 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         kc = jax.lax.dynamic_update_slice(state0["k"], k_new, (0, pos, 0, 0))
         vc = jax.lax.dynamic_update_slice(state0["v"], v_new, (0, pos, 0, 0))
         L = kc.shape[1]
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) / jnp.sqrt(
+        # grouped contraction against the COMPACT cache: never materialize
+        # the H-expanded K/V copies GQA exists to avoid
+        H = self.conf.n_heads
+        Hkv = kc.shape[2]
+        qg = q.reshape(B, T, Hkv, H // Hkv, Dh)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kc) / jnp.sqrt(
             jnp.asarray(Dh, q.dtype))
         kpos = jnp.arange(L)[None, :]
         qpos = pos + jnp.arange(T)[:, None]
         valid = kpos <= qpos
-        s = jnp.where(valid[None, None], s.astype(jnp.float32), -jnp.inf)
+        s = jnp.where(valid[None, None, None], s.astype(jnp.float32),
+                      -jnp.inf)
         p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, vc)
+        o = jnp.einsum("bhgqk,bkhd->bqhgd", p, vc).reshape(B, T, H, Dh)
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
         y = self._out(params, o, B, T)

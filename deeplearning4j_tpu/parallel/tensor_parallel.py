@@ -74,6 +74,13 @@ def shard_transformer_tp(net, mesh: Mesh,
     repl = NamedSharding(mesh, P())
 
     def put(arr, spec):
+        # a dim that the mesh axis does not evenly divide (e.g. a GQA
+        # layer's shrunken Wk/Wv) falls back to replication rather than
+        # crashing device_put
+        for d, ax in enumerate(spec):
+            if ax is not None and arr.shape[d] % mesh.shape[ax]:
+                spec = P()
+                break
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     for name, lp in net.params.items():

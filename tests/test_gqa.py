@@ -1,0 +1,107 @@
+"""Grouped-query attention: smaller KV projections + cache, same API.
+
+MHA (n_kv_heads == n_heads) must be bit-identical to the previous behavior;
+GQA shrinks Wk/Wv and the decode cache by n_heads/n_kv_heads and stays
+golden-equal between full forward and KV-cached incremental decode.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (GlobalPoolingLayer,
+                                               OutputLayer,
+                                               SelfAttentionLayer)
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayerImpl
+
+
+def _attn_net(n_kv_heads=None, causal=True, n_out=16, n_heads=4):
+    conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.05)
+            .list()
+            .layer(SelfAttentionLayer(n_in=8, n_out=n_out, n_heads=n_heads,
+                                      n_kv_heads=n_kv_heads, causal=causal,
+                                      activation="identity"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_in=n_out, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_gqa_param_and_cache_shapes():
+    net = _attn_net(n_kv_heads=2)   # 4 query heads, 2 kv heads, Dh=4
+    p = net.params[0]
+    assert p["Wq"].shape == (8, 16)
+    assert p["Wk"].shape == (8, 8)  # 2 heads * Dh=4
+    assert p["Wv"].shape == (8, 8)
+    impl = net._impls[0]
+    st = impl.init_state(3)
+    assert st["k"].shape[2] == 2    # cache holds only the KV heads
+
+
+def test_gqa_invalid_head_count_raises():
+    with pytest.raises(ValueError, match="divisor"):
+        _attn_net(n_kv_heads=3)     # 3 does not divide 4: rejected at init
+
+
+def test_gqa_trains_and_streams_consistently():
+    net = _attn_net(n_kv_heads=2)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 6, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    s0 = None
+    for _ in range(20):
+        net.fit(x, y)
+        s0 = net.score_
+    assert np.isfinite(s0)
+
+    # streaming decode equals full forward, timestep by timestep
+    impl = net._impls[0]
+    params = net.params[0]
+    attn_full, _ = impl.forward(params, x)
+    attn_full = np.asarray(attn_full)
+    state = impl.init_state(x.shape[0])
+    for t in range(x.shape[1]):
+        step, state = impl.forward_with_state(params, x[:, t:t + 1], state)
+        np.testing.assert_allclose(np.asarray(step)[:, 0], attn_full[:, t],
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=f"timestep {t}")
+
+
+def test_mha_unchanged_by_gqa_plumbing():
+    """n_kv_heads=None is exactly the old multi-head behavior."""
+    a = _attn_net(n_kv_heads=None)
+    b = _attn_net(n_kv_heads=4)     # explicit == implicit
+    for k in a.params[0]:
+        np.testing.assert_array_equal(np.asarray(a.params[0][k]),
+                                      np.asarray(b.params[0][k]))
+
+
+def test_gqa_zero_or_negative_kv_heads_rejected():
+    for bad in (0, -2):
+        with pytest.raises(ValueError, match="positive divisor"):
+            _attn_net(n_kv_heads=bad)
+
+
+def test_gqa_composes_with_tensor_parallel():
+    """A GQA layer whose shrunken Wk/Wv cannot shard over the model axis
+    falls back to replication instead of crashing device_put."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.tensor_parallel import shard_transformer_tp
+
+    conf = transformer_lm(vocab_size=11, d_model=16, n_heads=4, n_blocks=1)
+    for v in conf.vertices.values():
+        layer = getattr(v, "layer", None)
+        if layer is not None and hasattr(layer, "n_kv_heads"):
+            layer.n_kv_heads = 1    # Wk/Wv width 4: not divisible by 8
+    net = ComputationGraph(conf).init()
+    mesh = make_mesh({"model": 8})
+    shard_transformer_tp(net, mesh)   # must not raise
+    assert net.params["attn0"]["Wk"].sharding.is_fully_replicated
+    assert not net.params["attn0"]["Wq"].sharding.is_fully_replicated
+    rng = np.random.default_rng(0)
+    x = np.eye(11, dtype=np.float32)[rng.integers(0, 11, (2, 5))]
+    with mesh:
+        net.fit([x], [x])
+    assert np.isfinite(net.score_)
