@@ -161,7 +161,7 @@ def deep_autoencoder_mnist(seed: int = 123, lr: float = 0.05,
 def transformer_lm(vocab_size: int = 77, d_model: int = 128, n_heads: int = 4,
                    n_blocks: int = 2, ff_mult: int = 4, seed: int = 7,
                    lr: float = 3e-4, dtype: str = "float32",
-                   rope: bool = False):
+                   rope: bool = False, n_kv_heads=None):
     """Decoder-only transformer language model as a ComputationGraph.
 
     No 0.4-era reference counterpart (pre-transformer codebase) — built from
@@ -188,7 +188,7 @@ def transformer_lm(vocab_size: int = 77, d_model: int = 128, n_heads: int = 4,
         gb.add_layer(f"attn{i}",
                      SelfAttentionLayer(n_in=d_model, n_out=d_model,
                                         n_heads=n_heads, causal=True,
-                                        rope=rope,
+                                        rope=rope, n_kv_heads=n_kv_heads,
                                         activation="identity"), f"ln{i}a")
         gb.add_vertex(f"res{i}a", ElementWiseVertex(op="add"),
                       prev, f"attn{i}")

@@ -128,11 +128,33 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         x = self._dropout(x, train, rng)
         B, T, _ = x.shape
         q, k, v = self._qkv(params, x)
-        o = ophelpers.attention(q, self._expand_kv(k), self._expand_kv(v),
-                                causal=conf.causal)
+        if k.shape[2] != q.shape[2] and ophelpers.get_helper("attention") is None:
+            # GQA on the default XLA path: grouped contraction against the
+            # compact K/V — no H-expanded copies. A registered kernel
+            # (flash/splash) requires matching head counts, so the repeat
+            # only happens when a kernel is worth it (long context).
+            o = self._grouped_attention(q, k, v, causal=conf.causal)
+        else:
+            o = ophelpers.attention(q, self._expand_kv(k),
+                                    self._expand_kv(v), causal=conf.causal)
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
         return self._out(params, o, B, T), variables or {}
+
+    def _grouped_attention(self, q, k, v, *, causal):
+        """Dense attention with q grouped over compact KV heads.
+        q: [B, T, H, Dh]; k, v: [B, L, Hkv, Dh] -> [B, T, H, Dh]."""
+        B, T, H, Dh = q.shape
+        L, Hkv = k.shape[1], k.shape[2]
+        qg = q.reshape(B, T, Hkv, H // Hkv, Dh)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) / jnp.sqrt(
+            jnp.asarray(Dh, q.dtype))
+        if causal:
+            valid = jnp.arange(L)[None, :] <= jnp.arange(T)[:, None]
+            s = jnp.where(valid[None, None, None], s.astype(jnp.float32),
+                          jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(B, T, H, Dh)
 
     def forward_with_state(self, params, x, state0, *, train=False, rng=None,
                            mask=None):

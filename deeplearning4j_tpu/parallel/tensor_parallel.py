@@ -73,12 +73,19 @@ def shard_transformer_tp(net, mesh: Mesh,
     specs = _tp_specs_for_graph(net.conf, axis)
     repl = NamedSharding(mesh, P())
 
-    def put(arr, spec):
+    def put(arr, spec, pname=""):
         # a dim that the mesh axis does not evenly divide (e.g. a GQA
         # layer's shrunken Wk/Wv) falls back to replication rather than
-        # crashing device_put
+        # crashing device_put — loudly, so a misconfigured mesh is not a
+        # silent no-op
         for d, ax in enumerate(spec):
             if ax is not None and arr.shape[d] % mesh.shape[ax]:
+                import warnings
+                warnings.warn(
+                    f"shard_transformer_tp: {pname} dim {d} (size "
+                    f"{arr.shape[d]}) is not divisible by mesh axis "
+                    f"'{ax}' ({mesh.shape[ax]}); replicating this param",
+                    stacklevel=3)
                 spec = P()
                 break
         return jax.device_put(arr, NamedSharding(mesh, spec))
@@ -86,9 +93,10 @@ def shard_transformer_tp(net, mesh: Mesh,
     for name, lp in net.params.items():
         vspec = specs.get(name, {})
         net.params[name] = {
-            pname: put(arr, vspec.get(pname, P())) for pname, arr in lp.items()}
+            pname: put(arr, vspec.get(pname, P()), f"{name}/{pname}")
+            for pname, arr in lp.items()}
         net.updater_state[name] = {
-            pname: {k: put(v, vspec.get(pname, P()))
+            pname: {k: put(v, vspec.get(pname, P()), f"{name}/{pname}")
                     for k, v in state.items()}
             for pname, state in net.updater_state[name].items()}
     net.variables = jax.tree_util.tree_map(

@@ -1,0 +1,59 @@
+"""Long-context transformer LM: RoPE + GQA + remat + KV-cache generation.
+
+Trains a small decoder-only LM on a synthetic copy task (repeat the prompt
+after a separator — position-sensitive, so RoPE matters), then streams a
+completion through the KV cache.
+
+Run: python examples/long_context_lm.py [--steps N]
+On a TPU host, enable the autotuned attention kernels for long sequences:
+    from deeplearning4j_tpu.ops import pallas_kernels; pallas_kernels.enable()
+"""
+import argparse
+
+import numpy as np
+
+from deeplearning4j_tpu.models.sampling import generate_transformer
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def make_batch(rng, vocab, half, batch):
+    """[prompt | SEP | prompt] sequences; SEP is token 0, prompt in 1..V-1."""
+    prompt = rng.integers(1, vocab, (batch, half))
+    seq = np.concatenate([prompt, np.zeros((batch, 1), int), prompt], axis=1)
+    eye = np.eye(vocab, dtype=np.float32)
+    return seq, eye[seq[:, :-1]], eye[seq[:, 1:]]
+
+
+def main(steps: int = 300, vocab: int = 12, half: int = 8,
+         batch: int = 32) -> float:
+    conf = transformer_lm(vocab_size=vocab, d_model=64, n_heads=4,
+                          n_blocks=2, lr=3e-3, rope=True,
+                          n_kv_heads=2)  # grouped-query attention
+    conf.conf.remat = True          # rematerialize layer internals
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    for step in range(steps):
+        _, x, y = make_batch(rng, vocab, half, batch)
+        net.fit([x], [y])
+        if (step + 1) % 100 == 0:
+            print(f"step {step + 1}: loss={net.score_:.4f}")
+
+    # accuracy on the copied half (positions after SEP)
+    seq, x, _ = make_batch(rng, vocab, half, batch)
+    pred = np.asarray(net.output(x)[0]).argmax(-1)
+    acc = float((pred[:, half:] == seq[:, half + 1:]).mean())
+    print(f"copy accuracy: {acc:.4f}")
+
+    # stream a completion through the KV cache
+    prompt = list(seq[0, :half + 1])  # prompt + SEP
+    completion = generate_transformer(net, prompt, half, vocab,
+                                      use_cache=True)
+    print("prompt:", prompt[:-1], "-> completion:", completion)
+    return acc
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    main(p.parse_args().steps)

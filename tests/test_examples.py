@@ -40,6 +40,12 @@ def test_deep_belief_net_example():
     assert acc > 0.6
 
 
+def test_long_context_lm_example():
+    import long_context_lm
+    acc = long_context_lm.main(steps=250, vocab=9, half=6, batch=32)
+    assert acc > 0.8
+
+
 def test_transformer_example():
     import transformer_lm
     acc = transformer_lm.main(steps=60, vocab=11, seq_len=12, batch=16)

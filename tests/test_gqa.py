@@ -90,11 +90,8 @@ def test_gqa_composes_with_tensor_parallel():
     from deeplearning4j_tpu.parallel.mesh import make_mesh
     from deeplearning4j_tpu.parallel.tensor_parallel import shard_transformer_tp
 
-    conf = transformer_lm(vocab_size=11, d_model=16, n_heads=4, n_blocks=1)
-    for v in conf.vertices.values():
-        layer = getattr(v, "layer", None)
-        if layer is not None and hasattr(layer, "n_kv_heads"):
-            layer.n_kv_heads = 1    # Wk/Wv width 4: not divisible by 8
+    conf = transformer_lm(vocab_size=11, d_model=16, n_heads=4, n_blocks=1,
+                          n_kv_heads=1)  # Wk/Wv width 4: not divisible by 8
     net = ComputationGraph(conf).init()
     mesh = make_mesh({"model": 8})
     shard_transformer_tp(net, mesh)   # must not raise
@@ -105,3 +102,21 @@ def test_gqa_composes_with_tensor_parallel():
     with mesh:
         net.fit([x], [x])
     assert np.isfinite(net.score_)
+
+
+def test_grouped_attention_equals_expanded():
+    """The compact grouped contraction == repeat-then-dense attention."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops import helpers as oph
+    impl = SelfAttentionLayerImpl(SelfAttentionLayer(n_in=8, n_out=16,
+                                                     n_heads=4, n_kv_heads=2,
+                                                     causal=True))
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(2, 6, 4, 4)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 6, 2, 4)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 6, 2, 4)), jnp.float32)
+    grouped = impl._grouped_attention(q, k, v, causal=True)
+    expanded = oph.attention(q, impl._expand_kv(k), impl._expand_kv(v),
+                             causal=True)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(expanded),
+                               rtol=2e-5, atol=2e-6)
