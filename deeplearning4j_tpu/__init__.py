@@ -10,6 +10,7 @@ from .nn.conf.config import (MultiLayerConfiguration, NeuralNetConfiguration)
 from .nn.conf import layers
 from .nn.conf.inputs import InputType
 from .nn.multilayer import MultiLayerNetwork
+from .nn.graph import ComputationGraph
 from .nn.updater.updaters import (AdaDelta, AdaGrad, Adam, AdaMax, Nesterovs,
                                   NoOp, RmsProp, Sgd)
 from .datasets.dataset import DataSet, MultiDataSet
@@ -19,7 +20,7 @@ from .evaluation.evaluation import Evaluation, RegressionEvaluation
 
 __all__ = [
     "MultiLayerConfiguration", "NeuralNetConfiguration", "InputType", "layers",
-    "MultiLayerNetwork", "DataSet", "MultiDataSet", "DataSetIterator",
+    "MultiLayerNetwork", "ComputationGraph", "DataSet", "MultiDataSet", "DataSetIterator",
     "ListDataSetIterator", "AsyncDataSetIterator", "MultipleEpochsIterator",
     "Evaluation", "RegressionEvaluation",
     "Sgd", "Adam", "AdaGrad", "AdaDelta", "RmsProp", "Nesterovs", "NoOp", "AdaMax",
