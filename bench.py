@@ -1,7 +1,11 @@
-"""Benchmark: all five BASELINE.md workloads + MFU, one JSON line.
+"""Benchmark: the five BASELINE.md workloads + this framework's additions,
+one JSON line.
 
 Workloads (BASELINE.md): LeNet-MNIST, MLP-Iris, AlexNet-CIFAR10 (Adam+BN),
 GravesLSTM char-RNN (TBPTT window), Word2Vec skip-gram words/sec.
+Beyond the reference: the accelerated-helper seam deltas (LSTM kernel,
+long-context attention at L=8192), transformer LM at T=256 and end-to-end
+T=8192, and the 50k-point t-SNE Barnes-Hut-scale proof.
 
 The reference publishes no numbers (BASELINE.json `published:{}`), so
 `vs_baseline` compares the headline LeNet examples/sec against OUR round-2
