@@ -60,10 +60,12 @@ def generate_transformer(net, prompt_ids: Sequence[int], n_tokens: int,
         net.rnn_clear_previous_state()
         probs = np.asarray(
             net.rnn_time_step(onehot(prompt_ids))[0])[0, -1]
-        for _ in range(n_tokens):
+        for i in range(n_tokens):
             nxt = _sample_logits(probs, temperature, top_k, rng)
             out.append(nxt)
-            probs = np.asarray(net.rnn_time_step(onehot([nxt]))[0])[0, -1]
+            if i + 1 < n_tokens:  # the final token needs no forward pass
+                probs = np.asarray(
+                    net.rnn_time_step(onehot([nxt]))[0])[0, -1]
         return out
     ids = list(int(i) for i in prompt_ids)
     for _ in range(n_tokens):

@@ -141,8 +141,10 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
             o = o * mask[:, :, None, None].astype(o.dtype)
         return self._out(params, o, B, T), variables or {}
 
-    def _grouped_attention(self, q, k, v, *, causal):
-        """Dense attention with q grouped over compact KV heads.
+    def _grouped_attention(self, q, k, v, *, causal, qpos0=0):
+        """Dense attention with q grouped over compact KV heads — THE single
+        contraction for both the full forward (qpos0=0, L==T) and the
+        KV-cached decode step (qpos0=cache position, L=cache capacity).
         q: [B, T, H, Dh]; k, v: [B, L, Hkv, Dh] -> [B, T, H, Dh]."""
         B, T, H, Dh = q.shape
         L, Hkv = k.shape[1], k.shape[2]
@@ -150,7 +152,8 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) / jnp.sqrt(
             jnp.asarray(Dh, q.dtype))
         if causal:
-            valid = jnp.arange(L)[None, :] <= jnp.arange(T)[:, None]
+            valid = (jnp.arange(L)[None, :]
+                     <= qpos0 + jnp.arange(T)[:, None])
             s = jnp.where(valid[None, None, None], s.astype(jnp.float32),
                           jnp.finfo(jnp.float32).min)
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
@@ -172,7 +175,6 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
                 "positions the cache cannot know yet (same limitation as "
                 "bidirectional LSTM rnnTimeStep)")
         B, T, _ = x.shape
-        Dh = self.conf.n_out // self.conf.n_heads
         pos = state0["pos"]
         L_cap = state0["k"].shape[1]
         del rng  # no dropout on the inference step path
@@ -187,21 +189,9 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         q, k_new, v_new = self._qkv(params, x, pos0=pos)
         kc = jax.lax.dynamic_update_slice(state0["k"], k_new, (0, pos, 0, 0))
         vc = jax.lax.dynamic_update_slice(state0["v"], v_new, (0, pos, 0, 0))
-        L = kc.shape[1]
         # grouped contraction against the COMPACT cache: never materialize
         # the H-expanded K/V copies GQA exists to avoid
-        H = self.conf.n_heads
-        Hkv = kc.shape[2]
-        qg = q.reshape(B, T, Hkv, H // Hkv, Dh)
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kc) / jnp.sqrt(
-            jnp.asarray(Dh, q.dtype))
-        kpos = jnp.arange(L)[None, :]
-        qpos = pos + jnp.arange(T)[:, None]
-        valid = kpos <= qpos
-        s = jnp.where(valid[None, None, None], s.astype(jnp.float32),
-                      -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        o = jnp.einsum("bhgqk,bkhd->bqhgd", p, vc).reshape(B, T, H, Dh)
+        o = self._grouped_attention(q, kc, vc, causal=True, qpos0=pos)
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
         y = self._out(params, o, B, T)

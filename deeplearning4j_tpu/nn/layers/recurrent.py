@@ -67,6 +67,11 @@ def _materialize_rnn_states(impl_items, existing, batch, dtype, *,
         if not isinstance(impl, BaseRecurrentImpl):
             continue
         if tbptt and not impl.TBPTT_STATE:
+            # no cache allocated, but the key must exist: the step returns
+            # new_states for every stateful impl, and a key appearing only
+            # after window 1 would change the carried pytree structure and
+            # force a second XLA compile of the TBPTT train step
+            states.setdefault(key, None)
             continue
         if states.get(key) is None:
             states[key] = impl.init_state(batch, dtype)

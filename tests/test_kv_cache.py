@@ -125,6 +125,18 @@ def test_tbptt_state_excludes_kv_cache():
                                                      n_heads=2, causal=True))
     assert isinstance(impl, BaseRecurrentImpl)
     full = _materialize_rnn_states([("a", impl)], {}, 2, np.float32)
-    assert "a" in full                       # streaming decode gets a cache
+    assert full["a"] is not None             # streaming decode gets a cache
     tb = _materialize_rnn_states([("a", impl)], {}, 2, np.float32, tbptt=True)
-    assert "a" not in tb                     # TBPTT does not allocate one
+    # TBPTT allocates NO cache but keeps the key (stable carried-pytree
+    # structure: one XLA compile instead of two)
+    assert "a" in tb and tb["a"] is None
+
+
+def test_cached_generation_uses_exactly_cache_capacity():
+    """Regression: the final sampled token needs no forward pass, so
+    generation succeeds when max_cache_len == prompt + n_tokens - 1."""
+    from deeplearning4j_tpu.models.sampling import generate_transformer
+    V = 11
+    net = _net(V, cache=8)
+    toks = generate_transformer(net, [1, 2, 3, 4], 5, V, use_cache=True)
+    assert len(toks) == 5  # prompt(4) + 4 fed tokens == 8 == capacity
