@@ -45,12 +45,29 @@ class BatchNormalizationImpl(LayerImpl):
             gamma, beta = params["gamma"], params["beta"]
 
         if train and not conf.use_global_stats:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
-            d = jnp.asarray(conf.decay, variables["mean"].dtype)
+            if x.dtype in (jnp.bfloat16, jnp.float16):
+                # single-pass E[x^2]-E[x]^2 with f32 accumulation: one fused
+                # multi-output reduction over x instead of mean-then-var's
+                # two passes (the activations are the big HBM tensors; the
+                # device trace showed the two-pass stats as separate
+                # convert_reduce fusions). Safe only for sub-f32 inputs,
+                # where f32 accumulation has ~16 guard bits over the data's
+                # significand; for f32/f64 the cancellation E[x^2]-mean^2
+                # would destroy precision, so keep two-pass jnp.var there.
+                xf = x.astype(jnp.float32)
+                mean32 = jnp.mean(xf, axis=axes)
+                var32 = jnp.maximum(
+                    jnp.mean(xf * xf, axis=axes) - mean32 * mean32, 0.0)
+            else:
+                mean32 = jnp.mean(x, axis=axes)
+                var32 = jnp.var(x, axis=axes)
+            mean = mean32.astype(x.dtype)
+            var = var32.astype(x.dtype)
+            vdt = variables["mean"].dtype
+            d = jnp.asarray(conf.decay, vdt)
             new_vars = {
-                "mean": d * variables["mean"] + (1.0 - d) * mean,
-                "var": d * variables["var"] + (1.0 - d) * var,
+                "mean": d * variables["mean"] + (1.0 - d) * mean32.astype(vdt),
+                "var": d * variables["var"] + (1.0 - d) * var32.astype(vdt),
             }
         else:
             mean, var = variables["mean"], variables["var"]

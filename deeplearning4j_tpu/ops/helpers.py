@@ -125,6 +125,13 @@ def lstm_sequence(xproj_t: Array, rw: Array, peep: Array, h0: Array, c0: Array,
 # -- pool2d --------------------------------------------------------------------
 
 def _pool2d_default(x: Array, *, kind, kernel, stride, padding, pnorm=2) -> Array:
+    # NOTE (r4 device-trace study, tools/trace_alexnet.py): reduce_window is
+    # the RIGHT lowering here. Alternatives tried and measured worse on the
+    # full AlexNet step: rank-6 reshape+max (its gradient materializes
+    # [B,H/2,2,W/2,2,C] broadcasts) and strided-slice pairwise max (layout
+    # copies around every strided read). select-and-scatter for the 2x2/s2
+    # backward runs at ~memory roofline for the large shapes; the remaining
+    # win is cross-op fusion of the BN+act+pool epilogue, not the pool alone.
     kh, kw = kernel
     window = (1, kh, kw, 1)
     strides = (1, stride[0], stride[1], 1)

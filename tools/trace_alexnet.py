@@ -1,0 +1,75 @@
+"""Capture a jax.profiler device trace of the AlexNet train step and print
+the per-op time breakdown (tensorboard_plugin_profile parses the xplane).
+
+Run from /root/repo: `python tools/trace_alexnet.py [variant]`.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import alexnet_cifar10
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(0)
+    B = 512
+    x = jnp.asarray(rng.normal(size=(B, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, B)])
+    net = MultiLayerNetwork(alexnet_cifar10(dtype="bfloat16")).init()
+    scan_k = 16
+    xs = jnp.tile(x[None], (scan_k,) + (1,) * x.ndim)
+    ys = jnp.tile(y[None], (scan_k,) + (1,) * y.ndim)
+    _ = float(net.fit_scan(xs, ys)[-1])  # compile + warm
+
+    logdir = "/tmp/alexnet_trace"
+    os.system(f"rm -rf {logdir}")
+    with jax.profiler.trace(logdir):
+        for _ in range(4):
+            losses = net.fit_scan(xs, ys)
+        _ = float(losses[-1])
+
+    xplanes = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    print("xplane files:", xplanes, file=sys.stderr)
+    if not xplanes:
+        print("NO XPLANE CAPTURED")
+        return
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
+    for tool in ("op_profile", "overview_page"):
+        try:
+            data, _ = rtd.xspace_to_tool_data(xplanes, tool, {})
+            out = f"/tmp/alexnet_{tool}.json"
+            with open(out, "w") as f:
+                f.write(data if isinstance(data, str) else data.decode())
+            print("wrote", out, file=sys.stderr)
+        except Exception as e:
+            print(f"{tool} failed: {e!r}", file=sys.stderr)
+
+    # summarize op_profile if present
+    try:
+        prof = json.load(open("/tmp/alexnet_op_profile.json"))
+
+        def walk(node, depth=0, path=""):
+            m = node.get("metrics", {})
+            name = node.get("name", "?")
+            t = m.get("time", 0)
+            if depth <= 3 and t:
+                print(f"{'  '*depth}{name:60.60s} time={t}")
+            for ch in node.get("children", []):
+                walk(ch, depth + 1, path + "/" + name)
+
+        walk(prof.get("byProgram", prof.get("byCategory", prof)))
+    except Exception as e:
+        print("summarize failed:", repr(e), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
