@@ -61,9 +61,10 @@ def _lm_onehot(rng, vocab, t, b, k=None):
     return jnp.asarray(eye[ids[..., :-1]]), jnp.asarray(eye[ids[..., 1:]])
 
 
-def _time_graph_raw_steps(net, xs, ys, iters):
+def _time_graph_raw_steps(net, xs, ys, iters, blocks=3):
     """Drive a ComputationGraph's raw jitted train step `iters` times
     (single-step dispatch; the scan path is exercised by workload 4b).
+    Best-of-`blocks` timed blocks, one loss fetch per block.
     Returns (sec/step, flops/step, first loss, last loss)."""
     import jax
     import jax.numpy as jnp
@@ -75,17 +76,31 @@ def _time_graph_raw_steps(net, xs, ys, iters):
                        jnp.asarray(0), jax.random.PRNGKey(0), [xs], [ys],
                        None, None)
     first = float(loss)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        p, v, u, loss = sf(p, v, u, jnp.asarray(i + 1),
-                           jax.random.PRNGKey(i), [xs], [ys], None, None)
-    last = float(loss)
-    return (time.perf_counter() - t0) / iters, fl, first, last
+    best = float("inf")
+    step = 1
+    for _b in range(blocks):
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            p, v, u, loss = sf(p, v, u, jnp.asarray(step),
+                               jax.random.PRNGKey(step), [xs], [ys],
+                               None, None)
+            step += 1
+        last = float(loss)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best, fl, first, last
 
 
-def _bench_net(name, conf, x, y, batch, warmup, steps, dtype, scan_k=16):
+def _bench_net(name, conf, x, y, batch, warmup, steps, dtype, scan_k=16,
+               blocks=3):
     """Time training through the public multi-step path (fit_scan): K
-    minibatches per device dispatch, losses fetched once per chunk."""
+    minibatches per device dispatch, losses fetched ONCE per timed block.
+
+    Measurement model (r4, see docs/ROOFLINE_CNN.md): through the axon
+    tunnel a dispatch->fetch round trip costs ~105 ms, so each block's
+    per-step tax is ~105/steps ms — `steps` is sized per workload to keep
+    that under ~5% of the step. Best of `blocks` timed blocks: single-block
+    timings flap up to ~2x (VERDICT r3 weak #6), min is the noise-robust
+    estimator of true throughput."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -107,15 +122,16 @@ def _bench_net(name, conf, x, y, batch, warmup, steps, dtype, scan_k=16):
     # Sync via a host value fetch, NOT block_until_ready: through the axon
     # TPU tunnel block_until_ready returns at enqueue time (measured: a
     # matmul chain "runs" at 29x chip peak), while a scalar fetch must wait
-    # for the full dependency chain. Runs are long enough (seconds) that the
-    # ~0.1s tunnel round-trip is noise.
+    # for the full dependency chain.
     _ = float(net.fit_scan(xs, ys)[-1])
-    t0 = time.perf_counter()
-    for _ in range(chunks):
-        losses = net.fit_scan(xs, ys)
-    _ = float(losses[-1])
-    elapsed = time.perf_counter() - t0
-    step_s = elapsed / (chunks * scan_k)
+    best = float("inf")
+    for _b in range(blocks):
+        t0 = time.perf_counter()
+        for _ in range(chunks):
+            losses = net.fit_scan(xs, ys)
+        _ = float(losses[-1])
+        best = min(best, time.perf_counter() - t0)
+    step_s = best / (chunks * scan_k)
     ex_s = batch / step_s
     mfu = (flops / step_s / PEAK_FLOPS[dtype]) if flops else None
     entry = {
@@ -124,6 +140,7 @@ def _bench_net(name, conf, x, y, batch, warmup, steps, dtype, scan_k=16):
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_step": flops,
         "scan_batches_per_dispatch": scan_k,
+        "timing": f"best-of-{blocks} blocks, {chunks * scan_k} steps/fetch",
         "loss_first": round(first_loss, 4),
         "loss_last": round(float(losses[-1]), 4),
     }
@@ -145,12 +162,16 @@ def main() -> None:
     dtype = "bfloat16" if on_tpu else "float32"
     rng = np.random.default_rng(0)
 
+    # inputs are fed in the net's compute dtype (the data pipeline supplies
+    # bf16 on TPU): feeding f32 costs a 100 MB convert per scan chunk
+    in_dt = jnp.bfloat16 if on_tpu else jnp.float32
+
     # ---- 1. LeNet-MNIST (headline; Nesterovs, SGD-class) --------------------
     B = 512
-    x = jnp.asarray(rng.normal(size=(B, 28, 28, 1)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(B, 28, 28, 1)), in_dt)
     y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, B)])
     _, lenet = _bench_net("lenet_mnist", lenet_mnist(dtype=dtype), x, y,
-                          B, 2, 960, dtype, scan_k=32)
+                          B, 2, 3840, dtype, scan_k=64)
 
     # ---- 2. MLP-Iris (real data; convergence + accuracy) --------------------
     from deeplearning4j_tpu.datasets.fetchers import (IrisDataSetIterator,
@@ -159,24 +180,47 @@ def main() -> None:
     iris = load_iris_dataset()
     xi = jnp.asarray(iris.features)
     yi = jnp.asarray(iris.labels)
-    net_i, _ = _bench_net("mlp_iris", mlp_iris(), xi, yi, 150, 2, 3840,
+    net_i, _ = _bench_net("mlp_iris", mlp_iris(), xi, yi, 150, 2, 7680,
                           dtype="float32", scan_k=64)
     WORKLOADS["mlp_iris"]["accuracy"] = round(
         net_i.evaluate(IrisDataSetIterator(batch=150)).accuracy(), 4)
 
     # ---- 3. AlexNet-CIFAR10 (Adam + BatchNorm + dropout) --------------------
     B = 512
-    x = jnp.asarray(rng.normal(size=(B, 32, 32, 3)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(B, 32, 32, 3)), in_dt)
     y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, B)])
     _bench_net("alexnet_cifar10", alexnet_cifar10(dtype=dtype), x, y,
-               B, 2, 512, dtype)
+               B, 2, 2048, dtype, scan_k=32)
+    if on_tpu:
+        # accelerated-helper seam engaged on the CNN path: the fused
+        # BN+act+pool composite autotunes per shape against XLA (silent
+        # fallback — at these shapes XLA usually wins; docs/ROOFLINE_CNN.md
+        # has the full study). Decisions are recorded either way.
+        pallas_kernels.enable(interpret=False)
+        pallas_kernels.clear_autotune_cache()
+        try:
+            _bench_net("alexnet_cifar10_pallas", alexnet_cifar10(dtype=dtype),
+                       x, y, B, 2, 2048, dtype, scan_k=32)
+            entry = WORKLOADS["alexnet_cifar10_pallas"]
+            dec = {str(k): v for k, v in
+                   pallas_kernels.autotune_decisions().items()
+                   if k[0] == "bn_act_pool"}
+            entry["autotune_decisions"] = dec
+            entry["autotune_selected"] = (
+                "pallas_kernel" if any(dec.values()) else "xla_fallback")
+            base = WORKLOADS["alexnet_cifar10"]["examples_per_sec"]
+            entry["helper_delta_vs_xla"] = (
+                round(entry["examples_per_sec"] / base, 3)
+                if any(dec.values()) else 1.0)
+        finally:
+            pallas_kernels.disable()
 
     # ---- 4. GravesLSTM char-RNN (one TBPTT window), helper on/off delta -----
     B, T, V = 128, 50, 77
     xs = jnp.asarray(rng.normal(size=(B, T, V)), jnp.float32)
     ys = jnp.asarray(np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))])
     _bench_net("char_rnn_lstm", char_rnn_lstm(dtype=dtype), xs, ys,
-               B, 2, 256, dtype)
+               B, 2, 2048, dtype)
     if on_tpu:  # helper seam with per-shape autotuned Pallas LSTM (cuDNN
         # find-algorithm analog) — SAME dtype as the XLA baseline.
         # Run-to-run timing variance through the axon tunnel is ~2x on
@@ -188,7 +232,7 @@ def main() -> None:
         pallas_kernels.clear_autotune_cache()
         try:
             _bench_net("char_rnn_lstm_pallas", char_rnn_lstm(dtype=dtype),
-                       xs, ys, B, 2, 256, dtype)
+                       xs, ys, B, 2, 2048, dtype)
             entry = WORKLOADS["char_rnn_lstm_pallas"]
             decisions = pallas_kernels.autotune_decisions()
             entry["autotune_decisions"] = {
@@ -218,7 +262,7 @@ def main() -> None:
         qa = jnp.asarray(rng.normal(size=(1, La, Ha, Da)), jnp.bfloat16)
         from deeplearning4j_tpu.ops import helpers as _oph
 
-        def _attn_time(train, iters=40):
+        def _attn_time(train, iters=60, blocks=3):
             if train:
                 fn = jax.jit(jax.grad(lambda a: jnp.sum(
                     _oph.attention(a, a, a,
@@ -227,11 +271,14 @@ def main() -> None:
                 fn = jax.jit(lambda a: _oph.attention(a, a, a, causal=True))
             out = fn(qa)
             _ = float(jnp.sum(out.astype(jnp.float32)))
-            t0 = _t.perf_counter()
-            for _i in range(iters):
-                out = fn(qa)
-            _ = float(jnp.sum(out.astype(jnp.float32)))
-            return (_t.perf_counter() - t0) / iters
+            best = float("inf")
+            for _b in range(blocks):
+                t0 = _t.perf_counter()
+                for _i in range(iters):
+                    out = fn(qa)
+                _ = float(jnp.sum(out.astype(jnp.float32)))
+                best = min(best, (_t.perf_counter() - t0) / iters)
+            return best
 
         t_xla_f = _attn_time(False, iters=80)
         t_xla_t = _attn_time(True)
@@ -272,11 +319,13 @@ def main() -> None:
     gl = gnet.fit_scan([gxs], [gys])
     tr_first = float(gl[0])
     _ = float(gnet.fit_scan([gxs], [gys])[-1])
-    t0 = time.perf_counter()
-    for _i in range(16):
-        gl = gnet.fit_scan([gxs], [gys])
-    _ = float(gl[-1])
-    tr_dt = (time.perf_counter() - t0) / (16 * 8)
+    tr_dt = float("inf")
+    for _b in range(3):  # best-of-3, ~0.3% fetch tax at 384 steps/block
+        t0 = time.perf_counter()
+        for _i in range(48):
+            gl = gnet.fit_scan([gxs], [gys])
+        _ = float(gl[-1])
+        tr_dt = min(tr_dt, (time.perf_counter() - t0) / (48 * 8))
     WORKLOADS["transformer_lm"] = {
         "examples_per_sec": round(Bt / tr_dt, 1),
         "tokens_per_sec": round(Bt * Tt / tr_dt, 1),
@@ -302,7 +351,7 @@ def main() -> None:
                 vocab_size=Vl, d_model=512, n_heads=8, n_blocks=4,
                 dtype=dtype)).init()
             ldt, lfl, l_first, l_last = _time_graph_raw_steps(
-                lnet, lxs, lys, iters=20)
+                lnet, lxs, lys, iters=48)
             WORKLOADS["transformer_lm_long"] = {
                 "tokens_per_sec": round(Bl * Tl / ldt, 1),
                 "step_ms": round(ldt * 1e3, 3),
@@ -397,6 +446,30 @@ def main() -> None:
     except Exception as e:  # convergence artifact is best-effort
         WORKLOADS["lenet_mnist"]["mnist_accuracy_8_epochs"] = f"error: {e}"
 
+    # ---- perf-regression gate vs committed floors (BENCH_FLOORS.json) ----
+    regressions = []
+    try:
+        import os
+        floors_path = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "BENCH_FLOORS.json")
+        floors = json.load(open(floors_path))["floors"]
+        for wname, checks in floors.items():
+            w = WORKLOADS.get(wname)
+            if not isinstance(w, dict):
+                continue  # workload skipped (e.g. CPU run)
+            for field, bound in checks.items():
+                val = w.get(field)
+                if not isinstance(val, (int, float)):
+                    continue
+                if "min" in bound and val < bound["min"]:
+                    regressions.append(
+                        f"{wname}.{field}={val} < floor {bound['min']}")
+                if "max" in bound and val > bound["max"]:
+                    regressions.append(
+                        f"{wname}.{field}={val} > ceiling {bound['max']}")
+    except Exception as e:  # the gate must never kill the bench output
+        regressions = [f"gate error: {e}"]
+
     headline = WORKLOADS["lenet_mnist"]["examples_per_sec"]
     print(json.dumps({
         "metric": "LeNet-MNIST MultiLayerNetwork.fit examples/sec/chip",
@@ -406,6 +479,7 @@ def main() -> None:
         "baseline_source": "round-2 self-measurement (reference publishes none)",
         "platform": dev.platform,
         "dtype": dtype,
+        "regressions": regressions,
         "workloads": WORKLOADS,
     }))
     print(f"# done: {len(WORKLOADS)} workloads", file=sys.stderr)

@@ -57,15 +57,36 @@ def generate_transformer(net, prompt_ids: Sequence[int], n_tokens: int,
 
     out = []
     if use_cache:
+        # NOTE: this resets (and on exit clears) the net's streaming KV
+        # state — callers interleaving their own rnn_time_step streaming
+        # must not share `net` with cached generation (ADVICE r3).
+        needed = len(prompt_ids) + max(n_tokens - 1, 0)
+        layer_confs = list(getattr(net.conf, "layers", []) or [])
+        for v in getattr(net.conf, "vertices", {}).values():  # graph nets
+            if getattr(v, "layer", None) is not None:
+                layer_confs.append(v.layer)
+        for conf in layer_confs:
+            cap = getattr(conf, "max_cache_len", None)
+            if (type(conf).__name__ == "SelfAttentionLayer"
+                    and cap is not None and needed > int(cap)):
+                raise ValueError(
+                    f"prompt ({len(prompt_ids)}) + n_tokens ({n_tokens}) "
+                    f"needs a KV cache of {needed} but max_cache_len="
+                    f"{int(cap)}; raise max_cache_len or generate fewer "
+                    f"tokens (checked upfront so no tokens are consumed "
+                    f"before the failure)")
         net.rnn_clear_previous_state()
-        probs = np.asarray(
-            net.rnn_time_step(onehot(prompt_ids))[0])[0, -1]
-        for i in range(n_tokens):
-            nxt = _sample_logits(probs, temperature, top_k, rng)
-            out.append(nxt)
-            if i + 1 < n_tokens:  # the final token needs no forward pass
-                probs = np.asarray(
-                    net.rnn_time_step(onehot([nxt]))[0])[0, -1]
+        try:
+            probs = np.asarray(
+                net.rnn_time_step(onehot(prompt_ids))[0])[0, -1]
+            for i in range(n_tokens):
+                nxt = _sample_logits(probs, temperature, top_k, rng)
+                out.append(nxt)
+                if i + 1 < n_tokens:  # the final token needs no forward pass
+                    probs = np.asarray(
+                        net.rnn_time_step(onehot([nxt]))[0])[0, -1]
+        finally:
+            net.rnn_clear_previous_state()
         return out
     ids = list(int(i) for i in prompt_ids)
     for _ in range(n_tokens):

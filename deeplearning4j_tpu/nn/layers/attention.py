@@ -196,4 +196,11 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
             o = o * mask[:, :, None, None].astype(o.dtype)
         y = self._out(params, o, B, T)
         y = jnp.where(overflow, jnp.asarray(jnp.nan, y.dtype), y)
-        return y, {"k": kc, "v": vc, "pos": pos + T}
+        # freeze the state on overflow (ADVICE r3): pos sticks at the
+        # L_cap+1 sentinel so every LATER step also sees overflow and keeps
+        # poisoning its output — the clamp-corrupted cache can never be
+        # silently extended or wrapped back into a valid-looking range.
+        # Recovery is rnn_clear_previous_state(), as documented above.
+        next_pos = jnp.where(overflow, jnp.asarray(L_cap + 1, jnp.int32),
+                             pos + T)
+        return y, {"k": kc, "v": vc, "pos": next_pos}

@@ -45,22 +45,7 @@ class BatchNormalizationImpl(LayerImpl):
             gamma, beta = params["gamma"], params["beta"]
 
         if train and not conf.use_global_stats:
-            if x.dtype in (jnp.bfloat16, jnp.float16):
-                # single-pass E[x^2]-E[x]^2 with f32 accumulation: one fused
-                # multi-output reduction over x instead of mean-then-var's
-                # two passes (the activations are the big HBM tensors; the
-                # device trace showed the two-pass stats as separate
-                # convert_reduce fusions). Safe only for sub-f32 inputs,
-                # where f32 accumulation has ~16 guard bits over the data's
-                # significand; for f32/f64 the cancellation E[x^2]-mean^2
-                # would destroy precision, so keep two-pass jnp.var there.
-                xf = x.astype(jnp.float32)
-                mean32 = jnp.mean(xf, axis=axes)
-                var32 = jnp.maximum(
-                    jnp.mean(xf * xf, axis=axes) - mean32 * mean32, 0.0)
-            else:
-                mean32 = jnp.mean(x, axis=axes)
-                var32 = jnp.var(x, axis=axes)
+            mean32, var32 = ophelpers.bn_batch_stats(x)
             mean = mean32.astype(x.dtype)
             var = var32.astype(x.dtype)
             vdt = variables["mean"].dtype
@@ -75,6 +60,46 @@ class BatchNormalizationImpl(LayerImpl):
 
         y = ophelpers.batch_norm(x, gamma, beta, mean, var, eps=conf.eps)
         return self.activation_fn()(y) if conf.activation not in (None, "identity", "linear") else y, new_vars
+
+
+    def forward_fused_pool(self, params, x, *, variables=None):
+        """Train-mode BN + activation + the FOLLOWING 2x2/s2 max-pool layer
+        as one composite op (ops/helpers.bn_act_pool). Engaged by the
+        facades when the layer pair matches (nn/multilayer._forward_impl);
+        the Pallas plugin overrides the composite's backward with a 2-pass
+        fused kernel (ops/pallas_kernels.py). Semantics are identical to
+        running the two layers separately."""
+        conf = self.conf
+        variables = variables or self.init_variables(x.dtype)
+        if conf.lock_gamma_beta:
+            gamma = jnp.full((conf.n_out,), float(conf.gamma), x.dtype)
+            beta = jnp.full((conf.n_out,), float(conf.beta), x.dtype)
+        else:
+            gamma, beta = params["gamma"], params["beta"]
+        y, mean32, var32 = ophelpers.bn_act_pool(
+            x, gamma, beta, eps=conf.eps,
+            activation=conf.activation or "identity")
+        vdt = variables["mean"].dtype
+        d = jnp.asarray(conf.decay, vdt)
+        new_vars = {
+            "mean": d * variables["mean"] + (1.0 - d) * mean32.astype(vdt),
+            "var": d * variables["var"] + (1.0 - d) * var32.astype(vdt),
+        }
+        return y, new_vars
+
+    @staticmethod
+    def can_fuse_pool(bn_conf, pool_conf, x) -> bool:
+        """True when [this BN layer -> pool_conf] matches the fused
+        composite: train batch stats, 2x2/s2 max pool with no effective
+        padding, even spatial dims."""
+        return (x.ndim == 4
+                and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0
+                and not bn_conf.use_global_stats
+                and pool_conf.pooling_type == "max"
+                and tuple(pool_conf.kernel_size) == (2, 2)
+                and tuple(pool_conf.stride) == (2, 2)
+                and (pool_conf.convolution_mode == "same"
+                     or tuple(pool_conf.padding) == (0, 0)))
 
 
 @register_impl("LocalResponseNormalization")

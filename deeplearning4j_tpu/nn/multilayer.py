@@ -138,9 +138,14 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------- forward ---
     def _forward_impl(self, params, variables, x, *, train, rng, fmask=None,
                       states=None, upto: Optional[int] = None,
-                      in_scan: bool = False):
+                      in_scan: bool = False, fuse_pairs: bool = False):
         """Pure forward through layers [0, upto). Returns
-        (activations per layer, new variables, new rnn states)."""
+        (activations per layer, new variables, new rnn states).
+
+        ``fuse_pairs`` (set ONLY by the train-step loss path, where acts
+        feed nothing but the loss) enables the BN+pool composite; public
+        per-layer activation consumers (feed_forward, gradient checks)
+        keep the exact layerwise outputs."""
         conf = self.conf
         n = len(self._impls) if upto is None else upto
         x = self._adapt_input(x)
@@ -161,7 +166,8 @@ class MultiLayerNetwork:
             params = _cast_floats(params, dtype)
         if jnp.issubdtype(cur.dtype, jnp.floating) and cur.dtype != dtype:
             cur = cur.astype(dtype)  # cast input to the net's compute dtype
-        for i in range(n):
+        i = 0
+        while i < n:
             proc = conf.preprocessor(i)
             if proc is not None:
                 if isinstance(proc, (FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor)):
@@ -173,6 +179,29 @@ class MultiLayerNetwork:
             impl = self._impls[i]
             lmask_arg = fmask if cur.ndim == 3 else None
             ckpt = train and getattr(conf.conf, "remat", False)
+            # BN+act+pool pair fusion (ops/helpers.bn_act_pool): one
+            # composite op for [BatchNormalization -> 2x2/s2 max pool] in
+            # train mode — the Pallas plugin replaces its backward with a
+            # 2-pass fused kernel (the XLA backward costs ~4 HBM passes:
+            # select-and-scatter + act/BN-dx + two stat-grad reductions).
+            if (train and fuse_pairs and not ckpt and i + 1 < n
+                    and hasattr(impl, "forward_fused_pool")
+                    and type(self._impls[i + 1]).__name__
+                    == "SubsamplingLayerImpl"
+                    and conf.preprocessor(i + 1) is None
+                    and impl.can_fuse_pool(impl.conf,
+                                           self._impls[i + 1].conf, cur)):
+                y, nv = impl.forward_fused_pool(params[i], cur,
+                                                variables=variables[i])
+                new_vars[i] = nv
+                if jnp.issubdtype(y.dtype, jnp.floating) and y.dtype != dtype:
+                    y = y.astype(dtype)
+                # both fused layers record the pooled output
+                acts.append(y)
+                acts.append(y)
+                cur = y
+                i += 2
+                continue
             if isinstance(impl, BaseRecurrentImpl):
                 state0 = (states or {}).get(i)
                 y, st = remat_forward(impl, train=train, ckpt=ckpt,
@@ -188,6 +217,7 @@ class MultiLayerNetwork:
                 y = y.astype(dtype)  # stop f32 creep (e.g. BN's f32 stats)
             acts.append(y)
             cur = y
+            i += 1
         return acts, new_vars, new_states
 
     def _loss_from_output(self, out: Array, y: Array, lmask: Optional[Array]):
@@ -250,7 +280,8 @@ class MultiLayerNetwork:
         def loss_fn(params, variables, x, y, fmask, lmask, rng, states):
             acts, new_vars, new_states = self._forward_impl(
                 params, variables, x, train=True, rng=rng, fmask=fmask,
-                states=states if carry_state else None, in_scan=in_scan)
+                states=states if carry_state else None, in_scan=in_scan,
+                fuse_pairs=True)
             out = acts[-1]
             loss = self._loss_from_output(out, y, lmask) + self._reg_loss(params)
             return loss.astype(jnp.float32), (new_vars, new_states)

@@ -175,6 +175,55 @@ def batch_norm(x, gamma, beta, mean, var, *, eps=1e-5) -> Array:
     return impl(x, gamma, beta, mean, var, eps=eps)
 
 
+# -- fused train-mode BatchNorm + activation + 2x2/s2 max-pool ----------------
+
+def bn_batch_stats(x) -> Tuple[Array, Array]:
+    """Per-channel batch (mean, var) over all-but-last axes — THE single
+    definition of the BN stats math. For sub-f32 inputs: one-pass
+    E[x^2]-E[x]^2 with f32 accumulation (one fused multi-output reduction,
+    fusable into the producer conv's epilogue; f32 has ~16 guard bits over
+    bf16/f16 significands so the cancellation is safe). For f32/f64 the
+    cancellation would destroy precision, so two-pass jnp.var is kept.
+    Callers: BatchNormalizationImpl.forward, _bn_act_pool_default, and the
+    Pallas bn_act_pool override."""
+    axes = tuple(range(x.ndim - 1))
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        xf = x.astype(jnp.float32)
+        mean32 = jnp.mean(xf, axis=axes)
+        var32 = jnp.maximum(
+            jnp.mean(xf * xf, axis=axes) - mean32 * mean32, 0.0)
+    else:
+        mean32 = jnp.mean(x, axis=axes)
+        var32 = jnp.var(x, axis=axes)
+    return mean32, var32
+
+
+def _bn_act_pool_default(x, gamma, beta, *, eps, activation):
+    from . import activations
+    mean32, var32 = bn_batch_stats(x)
+    y = batch_norm(x, gamma, beta, mean32.astype(x.dtype),
+                   var32.astype(x.dtype), eps=eps)
+    y = activations.get(activation)(y)
+    y = pool2d(y, kind="max", kernel=(2, 2), stride=(2, 2), padding="SAME")
+    return y, mean32, var32
+
+
+def bn_act_pool(x, gamma, beta, *, eps=1e-5, activation="relu"):
+    """Train-mode batch norm (batch stats) + activation + 2x2/s2 max-pool as
+    ONE composite op, returning (pooled, batch_mean32, batch_var32).
+
+    Why a composite exists at the seam: the device trace of the AlexNet
+    train step (tools/trace_alexnet.py) shows XLA's BACKWARD for this
+    layer-pair costs ~4 HBM passes over the largest activations
+    (select-and-scatter pool grad + act/BN-dx passes + two stat-grad
+    reductions); a fused custom-VJP kernel does it in two
+    (ops/pallas_kernels.py). Reference analog: the cuDNN BN helper fuses
+    normalize+activation the same way (CudnnBatchNormalizationHelper).
+    Requires x [B,H,W,C] with even H and W."""
+    impl = _HELPERS.get("bn_act_pool", _bn_act_pool_default)
+    return impl(x, gamma, beta, eps=eps, activation=activation)
+
+
 # -- local response normalization ---------------------------------------------
 
 def _lrn_default(x: Array, *, k, n, alpha, beta) -> Array:

@@ -325,12 +325,15 @@ def autotune_decisions() -> Dict:
     out = {("lstm",) + k: v for k, v in _AUTOTUNE_CACHE.items()}
     out.update({("attention",) + k: v
                 for k, v in _ATTN_AUTOTUNE_CACHE.items()})
+    out.update({("bn_act_pool",) + k: v
+                for k, v in _BNAP_AUTOTUNE_CACHE.items()})
     return out
 
 
 def clear_autotune_cache() -> None:
     _AUTOTUNE_CACHE.clear()
     _ATTN_AUTOTUNE_CACHE.clear()
+    _BNAP_AUTOTUNE_CACHE.clear()
 
 
 def _eagerly(fn):
@@ -454,6 +457,270 @@ def lstm_sequence_pallas(xproj_t, rw, peep, h0, c0, *, activation, reverse):
         return helpers._lstm_sequence_default(
             xproj_t, rw, peep, h0, c0, activation=activation, reverse=reverse)
     return _get_lstm_fn(activation, bool(reverse))(xproj_t, rw, peep, h0, c0)
+
+
+# =============================================================================
+# fused BN+act+pool backward (bn_act_pool composite seam)
+# =============================================================================
+
+# activation + derivative pairs the fused backward can recompute in-kernel
+_BNAP_ACTS = {
+    "relu": (lambda z: jnp.maximum(z, 0.0),
+             lambda z: (z > 0).astype(jnp.float32)),
+    "identity": (lambda z: z, lambda z: jnp.ones_like(z)),
+    "linear": (lambda z: z, lambda z: jnp.ones_like(z)),
+    "tanh": (jnp.tanh, lambda z: 1.0 - jnp.tanh(z) ** 2),
+    "sigmoid": (jax.nn.sigmoid,
+                lambda z: jax.nn.sigmoid(z) * (1.0 - jax.nn.sigmoid(z))),
+}
+
+
+def _bnap_recompute(x_ref, g_ref, p_ref, act_fn, dact_fn, ch_last):
+    """Shared recompute for both backward passes. The block is a 5D view
+    (2 pool-rows, W/2, 2 pool-cols, D1, D2) where (D1, D2) is (C, bb) for
+    the channels-sublane variant or (bb, C) for the channels-lane variant —
+    the two physical layouts XLA actually assigns to NHWC activations
+    ({0,3,2,1} batch-minor and {3,0,2,1}); feeding the matching transposed
+    VIEW makes the transpose a free bitcast instead of a real copy (the
+    row-major kernel measured 0.46 ms/step of pure layout copies around the
+    pallas calls). From x it rebuilds x_hat, z, the activation, the 2x2
+    argmax routing, and the routed gradient g_z — x and g are read from HBM
+    exactly once per pass."""
+    x = x_ref[...].astype(jnp.float32)        # (2, W2, 2, D1, D2)
+    expand = (lambda v: v[None, :]) if ch_last else (lambda v: v[:, None])
+    mean = expand(p_ref[0])
+    inv = expand(p_ref[1])
+    gam = expand(p_ref[2])
+    bet = expand(p_ref[3])
+    g = g_ref[...].astype(jnp.float32)        # (1, W2, 1, D1, D2)
+    xh = (x - mean) * inv
+    z = xh * gam + bet
+    a = act_fn(z)
+    m = jnp.max(a, axis=(0, 2), keepdims=True)    # (1, W2, 1, D1, D2)
+    eq = (a == m).astype(jnp.float32)
+    cnt = jnp.sum(eq, axis=(0, 2), keepdims=True)  # ties per 2x2 window
+    ga = eq * (g / cnt)  # even split among tied maxima — jnp.max's own
+    # gradient convention (select-and-scatter routes to one element; the
+    # difference exists only at exact ties, measure-zero for continuous
+    # data, and preserves total gradient mass)
+    return xh, ga * dact_fn(z)
+
+
+def _bnap_sums_kernel(x_ref, g_ref, p_ref, dg_ref, db_ref, *, act_fn,
+                      dact_fn, ch_last):
+    first = jnp.logical_and(pl.program_id(0) == 0, pl.program_id(1) == 0)
+
+    @pl.when(first)
+    def _():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    xh, gz = _bnap_recompute(x_ref, g_ref, p_ref, act_fn, dact_fn, ch_last)
+    axes = (0, 1, 2, 3) if ch_last else (0, 1, 2, 4)
+    db_ref[:] += jnp.sum(gz, axes)
+    dg_ref[:] += jnp.sum(gz * xh, axes)
+
+
+def _bnap_dx_kernel(x_ref, g_ref, p_ref, s_ref, dx_ref, *, act_fn, dact_fn,
+                    ch_last, n):
+    xh, gz = _bnap_recompute(x_ref, g_ref, p_ref, act_fn, dact_fn, ch_last)
+    expand = (lambda v: v[None, :]) if ch_last else (lambda v: v[:, None])
+    inv = expand(p_ref[1])
+    gam = expand(p_ref[2])
+    s_b = expand(s_ref[0]) / n
+    s_g = expand(s_ref[1]) / n
+    dx_ref[...] = (inv * gam * (gz - s_b - xh * s_g)).astype(dx_ref.dtype)
+
+
+def _bnap_batch_stats(x):
+    # shared dtype-guarded definition (one-pass only for sub-f32 inputs)
+    return helpers.bn_batch_stats(x)
+
+
+_bnap_vjp_cache: Dict = {}
+
+
+def _get_bnap_fn(eps, activation, variant="hwcb"):
+    """variant: which physical layout the backward kernels assume.
+    'hwcb' = batch on lanes (matches XLA's batch-minor {0,3,2,1}, the
+    layout picked for C < 128 activations); 'hwbc' = channels on lanes
+    (matches {3,0,2,1}, picked for C >= 128). The matching transposed view
+    turns the layout adaptation into a bitcast instead of a real copy."""
+    key = (float(eps), activation, variant)
+    if key in _bnap_vjp_cache:
+        return _bnap_vjp_cache[key]
+    act_fn, dact_fn = _BNAP_ACTS[activation]
+    ch_last = variant == "hwbc"
+
+    def fwd_chain(x, gamma, beta):
+        mean32, var32 = _bnap_batch_stats(x)
+        inv = jax.lax.rsqrt(var32 + eps)
+        z = (x.astype(jnp.float32) - mean32) * inv * gamma.astype(
+            jnp.float32) + beta.astype(jnp.float32)
+        a = act_fn(z).astype(x.dtype)
+        B, H, W, C = x.shape
+        p = jnp.max(a.reshape(B, H // 2, 2, W // 2, 2, C), axis=(2, 4))
+        return p, (mean32, var32)
+
+    @jax.custom_vjp
+    def fn(x, gamma, beta):
+        return fwd_chain(x, gamma, beta)[0]
+
+    def fn_fwd(x, gamma, beta):
+        p, (mean32, var32) = fwd_chain(x, gamma, beta)
+        return p, (x, gamma, beta, mean32, var32)
+
+    def fn_bwd(res, g):
+        x, gamma, beta, mean32, var32 = res
+        B, H, W, C = x.shape
+        W2 = W // 2
+        n = B * H * W
+        inv32 = jax.lax.rsqrt(var32 + eps)
+        p = jnp.stack([mean32, inv32, gamma.astype(jnp.float32),
+                       beta.astype(jnp.float32)])          # (4, C)
+        bb = 64 if ch_last else 128  # lanes need 128; sublane tiles 8x
+        Bp = _round_up(B, bb)
+        if Bp != B:
+            x = jnp.pad(x, ((0, Bp - B), (0, 0), (0, 0), (0, 0)))
+            g = jnp.pad(g, ((0, Bp - B), (0, 0), (0, 0), (0, 0)))
+        if ch_last:  # [H, W2, 2, B, C]
+            xv = x.transpose(1, 2, 0, 3).reshape(H, W2, 2, Bp, C)
+            gv = g.transpose(1, 2, 0, 3).reshape(H // 2, W2, 1, Bp, C)
+            xspec = pl.BlockSpec((2, W2, 2, bb, C),
+                                 lambda hi, bi: (hi, 0, 0, bi, 0))
+            gspec = pl.BlockSpec((1, W2, 1, bb, C),
+                                 lambda hi, bi: (hi, 0, 0, bi, 0))
+        else:        # [H, W2, 2, C, B]
+            xv = x.transpose(1, 2, 3, 0).reshape(H, W2, 2, C, Bp)
+            gv = g.transpose(1, 2, 3, 0).reshape(H // 2, W2, 1, C, Bp)
+            xspec = pl.BlockSpec((2, W2, 2, C, bb),
+                                 lambda hi, bi: (hi, 0, 0, 0, bi))
+            gspec = pl.BlockSpec((1, W2, 1, C, bb),
+                                 lambda hi, bi: (hi, 0, 0, 0, bi))
+        grid = (H // 2, Bp // bb)
+        common_in = [xspec, gspec,
+                     pl.BlockSpec((4, C), lambda hi, bi: (0, 0))]
+        dg, db = pl.pallas_call(
+            partial(_bnap_sums_kernel, act_fn=act_fn, dact_fn=dact_fn,
+                    ch_last=ch_last),
+            out_shape=(jax.ShapeDtypeStruct((C,), jnp.float32),
+                       jax.ShapeDtypeStruct((C,), jnp.float32)),
+            grid=grid,
+            in_specs=common_in,
+            out_specs=(pl.BlockSpec((C,), lambda hi, bi: (0,)),
+                       pl.BlockSpec((C,), lambda hi, bi: (0,))),
+            interpret=_INTERPRET,
+        )(xv, gv, p)
+        s = jnp.stack([db, dg])                             # (2, C)
+        dxv = pl.pallas_call(
+            partial(_bnap_dx_kernel, act_fn=act_fn, dact_fn=dact_fn,
+                    ch_last=ch_last, n=float(n)),
+            out_shape=jax.ShapeDtypeStruct(xv.shape, x.dtype),
+            grid=grid,
+            in_specs=common_in + [pl.BlockSpec((2, C),
+                                               lambda hi, bi: (0, 0))],
+            out_specs=xspec,
+            interpret=_INTERPRET,
+        )(xv, gv, p, s)
+        if ch_last:
+            dx = dxv.reshape(H, W, Bp, C).transpose(2, 0, 1, 3)
+        else:
+            dx = dxv.reshape(H, W, C, Bp).transpose(3, 0, 1, 2)
+        return (dx[:B], dg.astype(gamma.dtype), db.astype(beta.dtype))
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    _bnap_vjp_cache[key] = fn
+    return fn
+
+
+_BNAP_AUTOTUNE_CACHE: Dict = {}
+
+
+def _measure_scan(step_fn, x0, K=32, repeats=3) -> float:
+    """Per-iteration device time of ``step_fn`` measured as ONE jitted
+    lax.scan of K carry-chained applications + one host fetch. Sub-ms ops
+    CANNOT be timed per-dispatch through the axon tunnel: each dispatch
+    costs ~0.5-0.8 ms to enqueue and a dispatch->fetch cycle ~105 ms, so a
+    per-call probe measures the tunnel, not the op. The carry feeds back
+    into the input so XLA cannot hoist the body out of the loop."""
+    import time
+
+    def body(c, _):
+        return step_fn(c), None
+
+    run = jax.jit(lambda c: jax.lax.scan(body, c, None, length=K)[0])
+    out = run(x0)
+    _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    best = float("inf")
+    for _rep in range(repeats):
+        t0 = time.perf_counter()
+        out = run(x0)
+        _ = float(jnp.sum(
+            jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+        best = min(best, time.perf_counter() - t0)
+    return best / K
+
+
+@_eagerly
+def _autotune_bnap(B, H, W, C, dtype, eps, activation) -> bool:
+    """Measure the fused-backward composite against the XLA default on this
+    exact shape (train = fwd+bwd, the real usage) — the same cuDNN
+    find-algorithm discipline as the LSTM/attention seams, but scan-timed
+    (these ops are sub-ms; see _measure_scan)."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, H, W, C)), dtype)
+    gamma = jnp.ones((C,), dtype)
+    beta = jnp.zeros((C,), dtype)
+
+    def ref(x, gamma, beta):
+        return helpers._bn_act_pool_default(
+            x, gamma, beta, eps=eps, activation=activation)[0]
+
+    def train_step(fn):
+        g = jax.grad(lambda xc: jnp.sum(
+            fn(xc, gamma, beta).astype(jnp.float32) ** 2))
+        return lambda xc: xc + 1e-6 * g(xc).astype(xc.dtype)
+
+    best = None  # (time, variant)
+    for variant in ("hwcb", "hwbc"):
+        try:
+            t = _measure_scan(train_step(_get_bnap_fn(eps, activation,
+                                                      variant)), x)
+        except Exception:
+            continue
+        if best is None or t < best[0]:
+            best = (t, variant)
+    if best is None:
+        return False
+    t_r = _measure_scan(train_step(ref), x)
+    return best[1] if best[0] < t_r * 0.95 else False
+
+
+def bn_act_pool_pallas(x, gamma, beta, *, eps=1e-5, activation="relu"):
+    """bn_act_pool seam override: identical XLA forward, fused 2-pass Pallas
+    BACKWARD (pool-argmax routing + act' + BN stat-grads recomputed
+    in-kernel from x — select-and-scatter and the separate reduction passes
+    disappear). Per-shape autotuned with silent XLA fallback."""
+    B, H, W, C = x.shape
+    supported = (activation in _BNAP_ACTS and H % 2 == 0 and W % 2 == 0
+                 and C % 8 == 0 and W >= 4)
+    if not supported:
+        return helpers._bn_act_pool_default(x, gamma, beta, eps=eps,
+                                            activation=activation)
+    variant = "hwbc"  # interpreter/test default
+    if not _INTERPRET:
+        key = (B, H, W, C, jnp.dtype(x.dtype).name, float(eps), activation)
+        if key not in _BNAP_AUTOTUNE_CACHE:
+            _BNAP_AUTOTUNE_CACHE[key] = _autotune_bnap(
+                B, H, W, C, x.dtype, float(eps), activation)
+        variant = _BNAP_AUTOTUNE_CACHE[key]
+        if not variant:
+            return helpers._bn_act_pool_default(x, gamma, beta, eps=eps,
+                                                activation=activation)
+    pooled = _get_bnap_fn(float(eps), activation, variant)(x, gamma, beta)
+    mean32, var32 = _bnap_batch_stats(jax.lax.stop_gradient(x))
+    return pooled, mean32, var32
 
 
 # =============================================================================
@@ -637,6 +904,7 @@ def enable(interpret=None, use_conv=None) -> None:
         helpers.register_helper("conv2d_bias_act", conv2d_bias_act_pallas)
     helpers.register_helper("lstm_sequence", lstm_sequence_pallas)
     helpers.register_helper("attention", attention_pallas)
+    helpers.register_helper("bn_act_pool", bn_act_pool_pallas)
 
 
 def disable() -> None:
@@ -644,3 +912,4 @@ def disable() -> None:
     helpers.register_helper("conv2d_bias_act", None)
     helpers.register_helper("lstm_sequence", None)
     helpers.register_helper("attention", None)
+    helpers.register_helper("bn_act_pool", None)

@@ -96,6 +96,14 @@ def hybrid_mesh(dcn_shape: dict, ici_shape: dict) -> Mesh:
                 f"slice layout {[len(v) for v in by_slice.values()]} "
                 f"(need {n_slices} slices of >= {per_slice} devices)")
         # pseudo-slices: contiguous device blocks (single-slice / CPU test)
+        if n_slices > 1:
+            import warnings
+            warnings.warn(
+                f"hybrid_mesh: requested {n_slices} slices but only one "
+                f"real slice is present — falling back to pseudo-slice "
+                f"contiguous blocks, so the '{'/'.join(dcn_axes)}' DCN "
+                f"axis actually rides ICI. Fine for tests; on real "
+                f"hardware check the pod topology.", stacklevel=2)
         return make_mesh({**dcn_shape, **ici_shape})
     grid = np.asarray(usable).reshape(
         [int(s) for s in dcn_shape.values()] +

@@ -1,7 +1,10 @@
+from .durable import (DurableLogConsumer, DurableLogProducer,
+                      DurableStreamingTrainer)
 from .server import InferenceServer
 from .streaming import (QueueDataSetIterator, RecordToDataSetConverter,
                         ServeRoute, StreamingTrainingPipeline)
 
-__all__ = ["InferenceServer", "QueueDataSetIterator",
-           "RecordToDataSetConverter", "ServeRoute",
+__all__ = ["DurableLogConsumer", "DurableLogProducer",
+           "DurableStreamingTrainer", "InferenceServer",
+           "QueueDataSetIterator", "RecordToDataSetConverter", "ServeRoute",
            "StreamingTrainingPipeline"]

@@ -1,0 +1,113 @@
+"""Fused BN+act+pool composite: facade fusion equivalence + Pallas backward
+numerics (interpret mode).
+
+Reference parity anchor: the cuDNN BN helper fuses normalize+activation the
+same way (deeplearning4j-cuda-7.5 CudnnBatchNormalizationHelper.java); the
+2x2/s2 max-pool pair fusion is this framework's TPU-first extension (device
+trace showed the XLA backward for the pair costs ~4 HBM passes).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.layers.normalization import BatchNormalizationImpl
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater.updaters import Adam
+from deeplearning4j_tpu.ops import helpers
+from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+
+def _small_cnn(seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed).learning_rate(1e-2)
+            .updater(Adam()).list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                    padding=(1, 1), activation="identity"))
+            .layer(BatchNormalization(activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(8, 8, 2)).build())
+
+
+def test_facade_fusion_matches_layerwise():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 8, 8, 2)), jnp.float32)
+    y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+    xs, ys = jnp.tile(x[None], (3, 1, 1, 1, 1)), jnp.tile(y[None], (3, 1, 1))
+
+    net = MultiLayerNetwork(_small_cnn()).init()
+    losses = net.fit_scan(xs, ys)
+
+    orig = BatchNormalizationImpl.can_fuse_pool
+    try:
+        BatchNormalizationImpl.can_fuse_pool = staticmethod(
+            lambda *a: False)
+        net2 = MultiLayerNetwork(_small_cnn()).init()
+        losses2 = net2.fit_scan(xs, ys)
+    finally:
+        BatchNormalizationImpl.can_fuse_pool = orig
+    np.testing.assert_allclose(np.asarray(losses), np.asarray(losses2),
+                               rtol=1e-5, atol=1e-6)
+    for p1, p2 in zip(jax.tree_util.tree_leaves(net.params),
+                      jax.tree_util.tree_leaves(net2.params)):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("variant", ["hwcb", "hwbc"])
+@pytest.mark.parametrize("act", ["relu", "tanh", "identity"])
+def test_pallas_bnap_backward_matches_autodiff(variant, act):
+    pk._INTERPRET = True
+    try:
+        rng = np.random.default_rng(1)
+        B, H, W, C = 4, 8, 6, 16
+        x = jnp.asarray(rng.normal(size=(B, H, W, C)), jnp.float32)
+        gamma = jnp.asarray(rng.normal(size=(C,)) * 0.5 + 1.0, jnp.float32)
+        beta = jnp.asarray(rng.normal(size=(C,)) * 0.1, jnp.float32)
+
+        def ref_loss(args):
+            p, _, _ = helpers._bn_act_pool_default(
+                args[0], args[1], args[2], eps=1e-5, activation=act)
+            return jnp.sum(p ** 2)
+
+        fused = pk._get_bnap_fn(1e-5, act, variant)
+        p_ref = helpers._bn_act_pool_default(
+            x, gamma, beta, eps=1e-5, activation=act)[0]
+        np.testing.assert_allclose(np.asarray(fused(x, gamma, beta)),
+                                   np.asarray(p_ref), rtol=1e-5, atol=1e-5)
+        g_ref = jax.grad(ref_loss)((x, gamma, beta))
+        g_fus = jax.grad(lambda a: jnp.sum(fused(*a) ** 2))((x, gamma, beta))
+        for a, b in zip(g_ref, g_fus):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        pk._INTERPRET = False
+
+
+def test_seam_override_roundtrip():
+    """enable() registers the composite override; disable() restores the
+    default (silent-fallback semantics)."""
+    assert helpers.get_helper("bn_act_pool") is None
+    pk.enable(interpret=True)
+    try:
+        assert helpers.get_helper("bn_act_pool") is not None
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(4, 4, 4, 8)), jnp.float32)
+        p, m, v = helpers.bn_act_pool(x, jnp.ones((8,)), jnp.zeros((8,)),
+                                      eps=1e-5, activation="relu")
+        p0, m0, v0 = helpers._bn_act_pool_default(
+            x, jnp.ones((8,)), jnp.zeros((8,)), eps=1e-5, activation="relu")
+        np.testing.assert_allclose(np.asarray(p), np.asarray(p0),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(m), np.asarray(m0), rtol=1e-5)
+    finally:
+        pk.disable()
+    assert helpers.get_helper("bn_act_pool") is None

@@ -1,0 +1,153 @@
+"""Durable streaming transport: kill the consumer mid-stream (SIGKILL, no
+cleanup) and prove at-least-once delivery with zero record loss on resume
+(VERDICT r3 item 7; reference embedded-broker proof
+EmbeddedKafkaCluster.java:34 + CamelKafkaRouteBuilder train route)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.durable import (DurableLogConsumer,
+                                                DurableLogProducer,
+                                                DurableStreamingTrainer)
+from deeplearning4j_tpu.serving.streaming import RecordToDataSetConverter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONSUMER_SCRIPT = r"""
+import json, sys, time
+from deeplearning4j_tpu.serving.durable import DurableLogConsumer
+
+log, out, batch = sys.argv[1], sys.argv[2], int(sys.argv[3])
+c = DurableLogConsumer(log, group="workers")
+with open(out, "a") as f:
+    idle_until = time.monotonic() + 3.0
+    while time.monotonic() < idle_until:
+        recs = c.poll(batch)
+        if not recs:
+            time.sleep(0.01)
+            continue
+        idle_until = time.monotonic() + 3.0
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        f.flush()
+        c.commit()   # commit AFTER processing
+"""
+
+
+def test_torn_tail_frame_not_delivered(tmp_path):
+    log = str(tmp_path / "records.log")
+    p = DurableLogProducer(log)
+    p.send({"i": 0})
+    p.flush()
+    # simulate a producer killed mid-append: append half a frame
+    with open(log, "ab") as f:
+        import struct
+        import zlib
+        payload = json.dumps({"i": 1}).encode()
+        frame = struct.Struct("<HII").pack(0xD14A, len(payload),
+                                           zlib.crc32(payload)) + payload
+        f.write(frame[:len(frame) - 4])
+    c = DurableLogConsumer(log)
+    assert [r["i"] for r in c.poll()] == [0]
+    c.commit()
+    # producer completes the frame -> the record becomes visible
+    with open(log, "ab") as f:
+        f.write(frame[len(frame) - 4:])
+    assert [r["i"] for r in c.poll()] == [1]
+
+
+def test_kill_consumer_mid_stream_no_loss(tmp_path):
+    """Producer streams 400 records while a consumer subprocess is
+    SIGKILLed mid-stream and restarted: the union of processed records must
+    cover every produced record (duplicates allowed = at-least-once)."""
+    log = str(tmp_path / "records.log")
+    out = str(tmp_path / "processed.jsonl")
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-c", CONSUMER_SCRIPT, log, out, "16"],
+            env=env, cwd=str(tmp_path))
+
+    producer = DurableLogProducer(log, fsync_every=8)
+    consumer = spawn()
+    killed = False
+    for i in range(400):
+        producer.send({"i": i})
+        if i == 150:
+            producer.flush()
+            # let it make some progress, then kill WITHOUT cleanup
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and (
+                    not os.path.exists(out) or os.path.getsize(out) == 0):
+                time.sleep(0.05)
+            consumer.send_signal(signal.SIGKILL)
+            consumer.wait()
+            killed = True
+            consumer = spawn()
+    assert killed
+    producer.close()
+    rc = consumer.wait(timeout=120)
+    assert rc == 0
+
+    seen = [json.loads(l)["i"] for l in open(out)]
+    assert set(seen) == set(range(400)), (
+        f"lost records: {sorted(set(range(400)) - set(seen))[:10]}")
+    # the kill really exercised redelivery OR clean cursor resume
+    assert len(seen) >= 400
+
+
+def test_durable_trainer_resumes_training(tmp_path):
+    """DurableStreamingTrainer end-to-end: train, 'crash' (drop the trainer
+    mid-stream, cursor committed per batch), resume with a NEW consumer in
+    the same group — every record trains at least once and the model
+    separates the classes."""
+    import jax.numpy as jnp  # noqa: F401  (framework import path)
+    from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater.updaters import Sgd
+
+    def make_net():
+        return MultiLayerNetwork(
+            (NeuralNetConfiguration.builder().seed(3).learning_rate(0.5)
+             .updater(Sgd()).list()
+             .layer(DenseLayer(n_in=2, n_out=8, activation="tanh"))
+             .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                loss="negativeloglikelihood"))
+             .build())).init()
+
+    rng = np.random.default_rng(0)
+    log = str(tmp_path / "train.log")
+    producer = DurableLogProducer(log)
+    n = 512
+    labels = rng.integers(0, 2, n)
+    feats = rng.normal(size=(n, 2)) + labels[:, None] * 2.0
+    for f, l in zip(feats, labels):
+        producer.send([float(f[0]), float(f[1]), int(l)])
+    producer.flush()
+
+    net = make_net()
+    conv = RecordToDataSetConverter(label_index=-1, num_classes=2)
+    seen = []
+    t1 = DurableStreamingTrainer(
+        net, DurableLogConsumer(log, group="train"), conv, batch_size=64,
+        on_batch=lambda recs: seen.extend(recs))
+    t1.run_until_idle(idle_timeout=0.2, max_records=192)
+    assert t1.records_trained == 192
+
+    # crash: t1 is abandoned. A fresh consumer in the SAME group resumes
+    # from the committed cursor and covers the rest.
+    t2 = DurableStreamingTrainer(
+        net, DurableLogConsumer(log, group="train"), conv, batch_size=64,
+        on_batch=lambda recs: seen.extend(recs))
+    t2.run_until_idle(idle_timeout=0.2)
+    assert len(seen) >= n  # every record trained at least once
+    out = np.asarray(net.output(feats.astype(np.float32)))
+    acc = float((out.argmax(1) == labels).mean())
+    assert acc > 0.9, acc

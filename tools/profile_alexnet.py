@@ -120,7 +120,8 @@ def main():
     net = MultiLayerNetwork(alexnet_cifar10(dtype="bfloat16")).init()
     import jax
 
-    fwd = jax.jit(lambda p, v, x: net._forward_impl(p, v, x, train=False)[0][-1])
+    fwd = jax.jit(lambda p, v, x: net._forward_impl(
+        p, v, x, train=False, rng=None)[0][-1])
     o = [fwd(net.params, net.variables, x)]
 
     def fstep():

@@ -27,6 +27,55 @@ def load_xspace(logdir):
     return xs
 
 
+def categorize(name: str) -> str:
+    """Category from the HLO instruction NAME (the text before ' = ').
+    Opcode-after-type parsing breaks on tuple-shaped ops (the type then
+    contains spaces/parens), which silently mis-bucketed every multi-output
+    fusion AND the while wrapper."""
+    m = re.match(r"%([a-zA-Z][\w\-]*)", name)
+    d = (m.group(1) if m else name).lower()
+    if d.startswith("while"):
+        return "while-wrapper(double-count)"
+    if "select-and-scatter" in d:
+        return "maxpool-backward"
+    if "transpose_jvp" in d or "custom-call" in d:
+        return "pallas/custom-call"
+    if d.startswith("convert"):  # before the "conv" substring check
+        return "fusion(elementwise/reduce)"
+    if "conv" in d:
+        return "convolution"
+    if "dot" in d or "gemm" in d:
+        return "matmul"
+    if "reduce-window" in d or "reduce_window" in d:
+        return "pool"
+    if d.startswith(("copy", "transpose", "bitcast", "slice-done",
+                     "dynamic-update-slice", "dynamic_update_slice")):
+        return "copy/transpose/slice"
+    if "rng" in d or "threefry" in d:
+        return "rng"
+    if "fusion" in d or "reduce" in d or "convert" in d or "add" in d \
+            or "broadcast" in d or "multiply" in d or "divide" in d:
+        return "fusion(elementwise/reduce)"
+    return "other"
+
+
+def device_ms_per_step(logdir, steps) -> float:
+    """Total device time per train step, excluding the double-counted
+    while-loop wrapper events."""
+    xs = load_xspace(logdir)
+    dev = next(p for p in xs.planes if p.name.startswith("/device:TPU"))
+    meta = {m.id: m.name for m in dev.event_metadata.values()}
+    tot = 0
+    for line in dev.lines:
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            if categorize(meta.get(ev.metadata_id, "?")) \
+                    != "while-wrapper(double-count)":
+                tot += ev.duration_ps
+    return tot / 1e9 / steps
+
+
 def summarize(logdir, topn=30):
     xs = load_xspace(logdir)
     dev = next(p for p in xs.planes if p.name.startswith("/device:TPU"))
@@ -42,30 +91,7 @@ def summarize(logdir, topn=30):
             total_ps += ev.duration_ps
     cats = collections.Counter()
     for name, ps in by_name.items():
-        # opcode = token after "= type[...]{...} " — operands often contain
-        # misleading substrings (e.g. "%copy.64" as an input to a fusion)
-        m = re.match(r"%([\w\-.]+) = [^ ]+ ([\w\-]+)\(", name)
-        op = (m.group(2) if m else name.split("(")[0]).lower()
-        defname = (m.group(1) if m else "").lower()
-        if op == "while":
-            cat = "while-wrapper(double-count)"
-        elif "conv" in op or "conv" in defname:
-            cat = "convolution"
-        elif "dot" in op or "dot" in defname:
-            cat = "matmul"
-        elif "select-and-scatter" in op:
-            cat = "maxpool-backward"
-        elif "reduce-window" in op or "reduce-window" in defname:
-            cat = "pool"
-        elif op.startswith("copy") or "transpose" in op:
-            cat = "copy/transpose"
-        elif "rng" in op or "threefry" in defname:
-            cat = "rng"
-        elif "fusion" in op:
-            cat = "fusion(elementwise/reduce)"
-        else:
-            cat = "other"
-        cats[cat] += ps
+        cats[categorize(name)] += ps
     print(f"== {logdir}: device total {total_ps/1e9:.3f} ms ==")
     print("-- categories --")
     for cat, ps in cats.most_common():
