@@ -166,6 +166,34 @@ def main() -> None:
     # bf16 on TPU): feeding f32 costs a 100 MB convert per scan chunk
     in_dt = jnp.bfloat16 if on_tpu else jnp.float32
 
+    # ---- 5. Word2Vec skip-gram words/sec — runs FIRST: the pipeline is
+    # host-CPU-bound (pair generation) and words/sec collapses 2-4x when
+    # anything else loads the host (VERDICT r3 weak #4: idle-host protocol
+    # INSIDE bench.py, best-of-3). Synthetic zipf corpus; text8 is
+    # unfetchable here (zero egress). ------------------------------------
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    V, n_tokens = 5000, 600_000
+    zipf = 1.0 / np.arange(1, V + 1)
+    zipf /= zipf.sum()
+    tokens = rng.choice(V, size=n_tokens, p=zipf)
+    sents = [" ".join(f"w{t}" for t in tokens[i:i + 40])
+             for i in range(0, n_tokens, 40)]
+    rates = []
+    for _i in range(3):
+        w2v = (Word2Vec.builder().layer_size(100).window_size(5)
+               .negative_sample(5).min_word_frequency(1).epochs(1)
+               .batch_size(8192).seed(1).iterate(sents).build())
+        w2v.fit()
+        rates.append(w2v.words_per_sec_)
+    WORKLOADS["word2vec_skipgram"] = {
+        "words_per_sec": round(max(rates), 1),
+        "runs": [round(r, 1) for r in rates],
+        "note": "synthetic zipf corpus (no egress for text8); host pair-gen "
+                "included; best of 3 fits on an idle host (first workload "
+                "in the bench); steady-state (compile excluded by fit's "
+                "warmup)",
+    }
+
     # ---- 1. LeNet-MNIST (headline; Nesterovs, SGD-class) --------------------
     B = 512
     x = jnp.asarray(rng.normal(size=(B, 28, 28, 1)), in_dt)
@@ -302,6 +330,62 @@ def main() -> None:
             "autotune_decisions": attn_dec,
         }
 
+    # ---- 4a3. VERY-long-context attention: L=32k/64k recorded artifacts
+    # (r3 carried these only as prose claims — PARITY.md:36,93). The dense
+    # XLA path cannot compile here (the [L, L] scores alone exceed HBM), so
+    # the autotuned kernel wins by walkover; what matters is the recorded
+    # absolute cost. ------------------------------------------------------
+    if on_tpu:
+        for La2 in (32768, 65536):
+            pallas_kernels.enable(interpret=False)
+            try:
+                qa3 = jnp.asarray(rng.normal(size=(1, La2, 8, 128)),
+                                  jnp.bfloat16)
+                if La2 <= 32768:
+                    # through the seam: the autotuner measures candidates
+                    # and records its decision
+                    attn_fn = lambda x: _oph.attention(x, x, x, causal=True)
+                    kiters, sel = 6, None
+                else:
+                    # 64k: candidate probing itself can exhaust the compile
+                    # helper; use the flash kernel at the 32k-winning
+                    # block config directly (static choice, recorded)
+                    attn_fn = lambda x: pallas_kernels._flash_call(
+                        x, x, x, True, None, block=1024)
+                    kiters, sel = 2, "flash block=1024 (static)"
+
+                def _fwd_step(qc):
+                    return attn_fn(qc).astype(qc.dtype)
+
+                def _train_step(qc):
+                    g = jax.grad(lambda x: jnp.sum(
+                        attn_fn(x).astype(jnp.float32)))(qc)
+                    return qc + jnp.asarray(1e-6, qc.dtype) * g.astype(
+                        qc.dtype)
+
+                t_f = pallas_kernels._measure_scan(_fwd_step, qa3, K=kiters,
+                                                   repeats=2)
+                t_t = pallas_kernels._measure_scan(_train_step, qa3,
+                                                   K=kiters, repeats=2)
+                WORKLOADS[f"long_context_attention_{La2 // 1024}k"] = {
+                    "seq_len": La2,
+                    "fwd_ms": round(t_f * 1e3, 1),
+                    "train_ms": round(t_t * 1e3, 1),
+                    "autotune_decisions": sel or {
+                        str(k): v for k, v in
+                        pallas_kernels.autotune_decisions().items()
+                        if k[0] == "attention" and k[2] == La2},
+                    "note": "dense XLA cannot compile at this L (the [L,L] "
+                            "scores exceed HBM); kernel walkover — absolute "
+                            "cost is the artifact (B=1 H=8 D=128 bf16 "
+                            "causal)",
+                }
+            except Exception as e:
+                WORKLOADS[f"long_context_attention_{La2 // 1024}k"] = {
+                    "seq_len": La2, "error": str(e)[:200]}
+            finally:
+                pallas_kernels.disable()
+
     # ---- 4b. Transformer LM (beyond the reference: the long-context
     # workload this framework adds — causal attention + LayerNorm +
     # residual graph vertices; see models/zoo.transformer_lm) -------------
@@ -338,8 +422,12 @@ def main() -> None:
     }
 
     # ---- 4c. LONG-CONTEXT transformer: T=8192 end-to-end training with the
-    # helper seam's autotuned attention kernel (the workload the fixed
-    # trace-escaping autotune unlocks; dense XLA alone runs ~117 ms/step) --
+    # helper seam's autotuned attention kernel. r4 notes: B>1 was probed
+    # per VERDICT r3 #2 and the full model scales LINEARLY in B (284k
+    # tokens/s at B=4 vs 304k at B=1 — the apparent B=1 penalty came from
+    # an aliased-q=k=v microbenchmark, not the real model), so B=1 stays;
+    # heads are 4x128 instead of 8x64 — D=128 fills the MXU/VPU lanes and
+    # measures ~15-20% faster through the flash kernel. -------------------
     if on_tpu:
         Vl, Tl, Bl = 128, 8192, 1
         lxs, lys = _lm_onehot(rng, Vl, Tl, Bl)
@@ -348,52 +436,37 @@ def main() -> None:
         # workload's shapes in attention_decisions (4a2 probes D=128)
         try:
             lnet = ComputationGraph(transformer_lm(
-                vocab_size=Vl, d_model=512, n_heads=8, n_blocks=4,
+                vocab_size=Vl, d_model=512, n_heads=4, n_blocks=4,
                 dtype=dtype)).init()
             ldt, lfl, l_first, l_last = _time_graph_raw_steps(
                 lnet, lxs, lys, iters=48)
+            # flop accounting for the flash custom calls (measured):
+            # cost_analysis counts the FWD call at the full non-causal
+            # 4*T^2*d_model but the BWD calls at ~zero. Causal-honest
+            # usage is 2*T^2*d fwd + 4*T^2*d bwd = 6*T^2*d per layer, so
+            # the correction on top of the XLA-counted graph is
+            # +2*T^2*d_model per layer per example.
+            d_model, n_blocks = 512, 4
+            attn_analytic = n_blocks * 2 * Bl * Tl * Tl * d_model
             WORKLOADS["transformer_lm_long"] = {
                 "tokens_per_sec": round(Bl * Tl / ldt, 1),
                 "step_ms": round(ldt * 1e3, 3),
                 "mfu": round(lfl / ldt / PEAK_FLOPS[dtype], 4) if lfl else None,
                 "flops_per_step": lfl,
+                "flops_per_step_analytic": lfl and lfl + attn_analytic,
+                "mfu_analytic": round((lfl + attn_analytic) / ldt
+                                      / PEAK_FLOPS[dtype], 4) if lfl else None,
                 "loss_first": round(l_first, 4),
                 "loss_last": round(l_last, 4),
                 "attention_decisions": {
                     str(k): v for k, v in
                     pallas_kernels.autotune_decisions().items()
                     if k[0] == "attention"},
-                "config": "d_model=512 n_blocks=4 n_heads=8 T=8192 B=1 causal",
+                "config": f"d_model=512 n_blocks=4 n_heads=4(D=128) T={Tl} "
+                          f"B={Bl} causal",
             }
         finally:
             pallas_kernels.disable()
-
-    # ---- 5. Word2Vec skip-gram words/sec (synthetic zipf corpus; text8 is
-    # unfetchable here — zero egress) -----------------------------------------
-    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
-    V, n_tokens = 5000, 600_000
-    zipf = 1.0 / np.arange(1, V + 1)
-    zipf /= zipf.sum()
-    tokens = rng.choice(V, size=n_tokens, p=zipf)
-    sents = [" ".join(f"w{t}" for t in tokens[i:i + 40])
-             for i in range(0, n_tokens, 40)]
-    # two fits, report the better: the first fit in a process consistently
-    # pays tunnel/transfer ramp-up costs that a long real training run
-    # amortizes away (steady-state is what the reference's multi-hour
-    # text8 numbers measure)
-    rates = []
-    for _i in range(2):
-        w2v = (Word2Vec.builder().layer_size(100).window_size(5)
-               .negative_sample(5).min_word_frequency(1).epochs(1)
-               .batch_size(8192).seed(1).iterate(sents).build())
-        w2v.fit()
-        rates.append(w2v.words_per_sec_)
-    WORKLOADS["word2vec_skipgram"] = {
-        "words_per_sec": round(max(rates), 1),
-        "runs": [round(r, 1) for r in rates],
-        "note": "synthetic zipf corpus (no egress for text8); host pair-gen "
-                "included; best of 2 fits (steady state)",
-    }
 
     # ---- 6. t-SNE at N=50k (the Barnes-Hut scale proof: kNN-sparse
     # attractive + exact chunked repulsion; VERDICT r2 item 8) --------------
@@ -460,6 +533,11 @@ def main() -> None:
             for field, bound in checks.items():
                 val = w.get(field)
                 if not isinstance(val, (int, float)):
+                    # a missing FIELD on a present workload means a rename
+                    # or typo silently disabled this floor — report it
+                    regressions.append(
+                        f"{wname}.{field} missing/non-numeric "
+                        f"(gate cannot check it)")
                     continue
                 if "min" in bound and val < bound["min"]:
                     regressions.append(

@@ -52,18 +52,57 @@ class TrainingStateTracker:
         self.keep_last = max(1, keep_last)
         self._since_save = 0
         # worker lifecycle registry (reference addWorker/disableWorker
-        # :184-199): masters consult enabled workers when re-sharding
-        self._workers: Dict[str, bool] = {}
+        # :184-199). PERSISTED to the shared checkpoint directory (the
+        # reference keeps it in ZooKeeper-backed shared state): a job
+        # restarted after a host failure must see the same roster so it
+        # can disable the dead worker and re-shard (elastic-recovery test
+        # in tests/test_multihost.py).
+        self._workers: Dict[str, bool] = self._load_workers()
 
     # -- worker lifecycle (reference :184-199) ---------------------------------
+    def _workers_path(self) -> Path:
+        return self.dir / "workers.json"
+
+    def _load_workers(self) -> Dict[str, bool]:
+        try:
+            with open(self._workers_path()) as fh:
+                return {str(k): bool(v) for k, v in json.load(fh).items()}
+        except (OSError, ValueError):
+            return {}
+
+    def _mutate_workers(self, worker_id: str, value, *,
+                        keep_existing: bool) -> None:
+        """Read-merge-write under an exclusive flock so concurrent trackers
+        on the shared directory (multiple pod hosts registering at startup)
+        cannot clobber each other's registrations."""
+        import fcntl
+        lock_path = self.dir / "workers.lock"
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                on_disk = self._load_workers()  # freshest shared state wins
+                if keep_existing:
+                    on_disk.setdefault(worker_id, value)
+                else:
+                    on_disk[worker_id] = value
+                self._workers = on_disk
+                tmp = self._workers_path().with_suffix(".json.tmp")
+                with open(tmp, "w") as fh:
+                    json.dump(self._workers, fh)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, self._workers_path())
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+
     def add_worker(self, worker_id: str) -> None:
-        self._workers.setdefault(worker_id, True)
+        self._mutate_workers(worker_id, True, keep_existing=True)
 
     def enable_worker(self, worker_id: str) -> None:
-        self._workers[worker_id] = True
+        self._mutate_workers(worker_id, True, keep_existing=False)
 
     def disable_worker(self, worker_id: str) -> None:
-        self._workers[worker_id] = False
+        self._mutate_workers(worker_id, False, keep_existing=False)
 
     def workers(self) -> List[str]:
         return sorted(self._workers)

@@ -38,9 +38,38 @@ class DurableLogProducer:
 
     def __init__(self, path: str, fsync_every: int = 1):
         self.path = path
+        self._truncate_torn_tail(path)
         self._f = open(path, "ab")
         self._fsync_every = max(1, fsync_every)
         self._since_sync = 0
+
+    @staticmethod
+    def _truncate_torn_tail(path: str) -> None:
+        """A producer killed mid-append leaves a torn tail frame. Appending
+        fresh frames AFTER it would wedge every consumer forever (the torn
+        frame's CRC can never become valid), so a restarting producer scans
+        the frame chain and truncates at the first incomplete/corrupt tail
+        before appending."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        good = 0
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    break
+                magic, ln, crc = _HDR.unpack(hdr)
+                if magic != _MAGIC:
+                    break
+                payload = f.read(ln)
+                if len(payload) < ln or zlib.crc32(payload) != crc:
+                    break
+                good += _HDR.size + ln
+        if good < size:
+            with open(path, "r+b") as f:
+                f.truncate(good)
 
     def send(self, record) -> None:
         payload = json.dumps(record).encode()

@@ -88,7 +88,12 @@ int dl4j_init(void) {
     PyObject* r = PyRun_String(kBootstrap, Py_file_input, g_ns, g_ns);
     int ok = r != nullptr;
     Py_XDECREF(r);
-    if (!ok) PyErr_Print();
+    if (!ok) {
+        PyErr_Print();
+        Py_DECREF(g_ns);
+        g_ns = nullptr;  // a retry must re-run the bootstrap, not
+                         // report success against a dead namespace
+    }
     PyGILState_Release(gil);
     if (we_initialized) {
         // Py_InitializeEx left this thread holding the GIL; release it so
@@ -174,7 +179,7 @@ long dl4j_mlp_create(const long* sizes, int n_sizes, float lr, long seed) {
 float dl4j_train_step(long handle, const float* x, const float* y,
                       long rows, long x_cols, long y_cols) {
     std::lock_guard<std::mutex> lk(g_mu);
-    if (!g_ns) return -1;
+    if (!g_ns) return (float)(0.0 / 0.0);  // NaN per the error contract
     PyGILState_STATE gil = PyGILState_Ensure();
     float loss = (float)(0.0 / 0.0);
     PyObject *px = np_from(x, rows, x_cols), *py = np_from(y, rows, y_cols);

@@ -61,6 +61,28 @@ def test_torn_tail_frame_not_delivered(tmp_path):
     assert [r["i"] for r in c.poll()] == [1]
 
 
+def test_producer_restart_truncates_torn_tail(tmp_path):
+    """A producer killed mid-append must not wedge the log: on restart the
+    new producer truncates the torn tail frame before appending, so
+    consumers skip the garbage and deliver everything else."""
+    import struct
+    import zlib
+    log = str(tmp_path / "records.log")
+    p = DurableLogProducer(log)
+    p.send({"i": 0})
+    p.close()
+    payload = json.dumps({"i": "torn"}).encode()
+    frame = struct.Struct("<HII").pack(0xD14A, len(payload),
+                                       zlib.crc32(payload)) + payload
+    with open(log, "ab") as f:
+        f.write(frame[:len(frame) - 3])  # killed mid-append
+    p2 = DurableLogProducer(log)  # restart: truncates the torn tail
+    p2.send({"i": 1})
+    p2.close()
+    c = DurableLogConsumer(log)
+    assert [r["i"] for r in c.poll()] == [0, 1]
+
+
 def test_kill_consumer_mid_stream_no_loss(tmp_path):
     """Producer streams 400 records while a consumer subprocess is
     SIGKILLed mid-stream and restarted: the union of processed records must
