@@ -249,38 +249,12 @@ def main() -> None:
     ys = jnp.asarray(np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))])
     _bench_net("char_rnn_lstm", char_rnn_lstm(dtype=dtype), xs, ys,
                B, 2, 2048, dtype)
-    if on_tpu:  # helper seam with per-shape autotuned Pallas LSTM (cuDNN
-        # find-algorithm analog) — SAME dtype as the XLA baseline.
-        # Run-to-run timing variance through the axon tunnel is ~2x on
-        # identical programs, so the honest delta comes from the autotune
-        # decision itself: if the seam selects the XLA fallback the
-        # compiled program IS the baseline (delta == 1.0 by identity); if
-        # it selects the kernel, the measured ratio is reported.
-        pallas_kernels.enable(interpret=False)
-        pallas_kernels.clear_autotune_cache()
-        try:
-            _bench_net("char_rnn_lstm_pallas", char_rnn_lstm(dtype=dtype),
-                       xs, ys, B, 2, 2048, dtype)
-            entry = WORKLOADS["char_rnn_lstm_pallas"]
-            decisions = pallas_kernels.autotune_decisions()
-            entry["autotune_decisions"] = {
-                str(k): v for k, v in decisions.items()}
-            kernel_selected = any(decisions.values())
-            entry["autotune_selected"] = (
-                "pallas_kernel" if kernel_selected else "xla_fallback")
-            if kernel_selected:
-                entry["helper_delta_vs_xla"] = round(
-                    entry["examples_per_sec"]
-                    / WORKLOADS["char_rnn_lstm"]["examples_per_sec"], 3)
-            else:
-                entry["helper_delta_vs_xla"] = 1.0
-                entry["note"] = ("autotune measured the kernel slower for "
-                                 "training at this shape; the seam compiled "
-                                 "the identical XLA program (delta 1.0 by "
-                                 "identity; timing spread vs the baseline "
-                                 "row is tunnel noise)")
-        finally:
-            pallas_kernels.disable()
+    WORKLOADS["char_rnn_lstm"]["lstm_helper"] = (
+        "Pallas LSTM kernel RETIRED r4: scan-timed probes showed the XLA "
+        "lax.scan default winning at every regime incl. B>=256, "
+        "H in {512,1024} bf16 (ratios 0.65-1.0; the r1-r3 'wins' were "
+        "per-dispatch tunnel-noise artifacts). Seam + autotuner remain — "
+        "see the tombstone in ops/pallas_kernels.py and PARITY.md.")
 
     # ---- 4a2. long-context attention: the helper seam's flash kernel vs
     # XLA at L=8192 (block-autotuned; see ops/pallas_kernels.attention_pallas)
@@ -518,6 +492,30 @@ def main() -> None:
             net.evaluate(it).accuracy(), 4)
     except Exception as e:  # convergence artifact is best-effort
         WORKLOADS["lenet_mnist"]["mnist_accuracy_8_epochs"] = f"error: {e}"
+
+    # ---- 8. AlexNet-CIFAR10 convergence artifact (VERDICT r3 item 9):
+    # accuracy after a fixed epoch budget through the public fit(iterator)
+    # API. Real CIFAR batches load when present in ~/.dl4j_tpu_data; in
+    # this zero-egress environment the fetcher substitutes its
+    # deterministic class-structured synthetic set (documented fallback —
+    # the artifact proves end-to-end convergence of the full Adam+BN
+    # pipeline, same protocol as the MNIST row's sklearn fallback). ------
+    from deeplearning4j_tpu.datasets.fetchers import CifarDataSetIterator
+    try:
+        cnet = MultiLayerNetwork(alexnet_cifar10(dtype=dtype)).init()
+        cit = CifarDataSetIterator(batch=512, num_examples=4096)
+        for _ep in range(6):
+            cit.reset()
+            cnet.fit(cit)
+        cit.reset()
+        WORKLOADS["alexnet_cifar10"]["cifar10_accuracy"] = round(
+            cnet.evaluate(cit).accuracy(), 4)
+        WORKLOADS["alexnet_cifar10"]["cifar10_accuracy_note"] = (
+            "6 epochs x 4096 examples via public fit(iterator); synthetic "
+            "class-structured fallback data (no egress for real CIFAR — "
+            "drop the python batches into ~/.dl4j_tpu_data to use them)")
+    except Exception as e:
+        WORKLOADS["alexnet_cifar10"]["cifar10_accuracy"] = f"error: {e}"
 
     # ---- perf-regression gate vs committed floors (BENCH_FLOORS.json) ----
     regressions = []

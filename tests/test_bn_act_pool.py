@@ -46,7 +46,9 @@ def test_facade_fusion_matches_layerwise():
     net = MultiLayerNetwork(_small_cnn()).init()
     losses = net.fit_scan(xs, ys)
 
-    orig = BatchNormalizationImpl.can_fuse_pool
+    # grab the staticmethod DESCRIPTOR (class access would unwrap it and
+    # the restore would install a plain function = implicit self bug)
+    orig = BatchNormalizationImpl.__dict__["can_fuse_pool"]
     try:
         BatchNormalizationImpl.can_fuse_pool = staticmethod(
             lambda *a: False)
