@@ -153,13 +153,17 @@ def _digits_as_mnist(num: int, train: bool, binarize: bool) -> DataSet:
 def load_mnist(num: int = 60000, train: bool = True, binarize: bool = False) -> DataSet:
     found = _find_mnist(train)
     if found is None:
-        return _digits_as_mnist(num, train, binarize)
+        ds = _digits_as_mnist(num, train, binarize)
+        ds.source = "sklearn_digits_8x8_upscaled"  # honest stand-in label
+        return ds
     images = read_idx_f32(found[0], scale=1.0 / 255.0)
     labels = read_idx(found[1])
     images, labels = images[:num], labels[:num]
     if binarize:
         images = (images > 0.5).astype(np.float32)
-    return DataSet(images.reshape(images.shape[0], 784), one_hot(labels, 10))
+    ds = DataSet(images.reshape(images.shape[0], 784), one_hot(labels, 10))
+    ds.source = "mnist_idx"
+    return ds
 
 
 class MnistDataSetIterator(ListDataSetIterator):
@@ -194,13 +198,17 @@ def load_cifar10(num: int = 50000, train: bool = True) -> DataSet:
         y = np.concatenate(ys)[:num]
         # stored as [N, 3*1024] channel-major; to NHWC
         x = x.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-        return DataSet(x.reshape(x.shape[0], -1), one_hot(y, 10))
+        ds = DataSet(x.reshape(x.shape[0], -1), one_hot(y, 10))
+        ds.source = "cifar10_batches"
+        return ds
     rng = np.random.default_rng(7)
     y = rng.integers(0, 10, num)
     # class-dependent colored blobs + noise: learnable but nontrivial
     base_img = rng.normal(0, 1, (10, 32, 32, 3)).astype(np.float32)
     x = base_img[y] * 0.5 + rng.normal(0, 0.5, (num, 32, 32, 3)).astype(np.float32)
-    return DataSet(x.reshape(num, -1), one_hot(y, 10))
+    ds = DataSet(x.reshape(num, -1), one_hot(y, 10))
+    ds.source = "synthetic_class_structured"  # honest stand-in label
+    return ds
 
 
 class CifarDataSetIterator(ListDataSetIterator):

@@ -99,7 +99,7 @@ class ComputationGraph:
     def _vertex_forward(self, name: str, vertex: GraphVertex,
                         inputs: List[Array], params, variables, *,
                         train, rng, mask, vmasks, states, new_states,
-                        in_scan: bool = False):
+                        in_scan: bool = False, preouts=None):
         if isinstance(vertex, LayerVertex):
             x = inputs[0]
             if vertex.preprocessor is not None:
@@ -113,6 +113,15 @@ class ComputationGraph:
                     params[name], x, state0, rng, mask)
                 new_states[name] = st
                 return y, variables.get(name, {})
+            if preouts is not None and hasattr(impl, "forward_with_preout"):
+                # output vertex on the loss path: surface the pre-activation
+                # for the stable from-logits losses (no remat — the loss
+                # consumes it immediately)
+                y, z, nv = impl.forward_with_preout(
+                    params[name], x, train=train, rng=rng,
+                    variables=variables.get(name, {}), mask=mask)
+                preouts[name] = z
+                return y, nv
             y, nv = remat_forward(impl, train=train, ckpt=ckpt,
                                   recurrent=False, in_scan=in_scan)(
                 params[name], x, variables.get(name, {}), rng, mask)
@@ -163,9 +172,10 @@ class ComputationGraph:
 
     def _forward_impl(self, params, variables, inputs: Sequence[Array], *,
                       train, rng, fmasks=None, states=None,
-                      in_scan: bool = False):
+                      in_scan: bool = False, want_preout: bool = False):
         """Topo-ordered DAG forward. Returns (dict name->activation,
-        new variables, new rnn states)."""
+        new variables, new rnn states) — plus a dict of output-vertex
+        pre-activations as a 4th element when ``want_preout`` (loss path)."""
         conf = self.conf
         dtype = _compute_dtype_of(conf.conf)
         if dtype != _dtype_of(conf.conf):
@@ -192,6 +202,8 @@ class ComputationGraph:
         rngs = (list(jax.random.split(rng, n_layer)) if rng is not None
                 else [None] * n_layer)
         layer_rng = {name: rngs[i] for i, name in enumerate(sorted(self._impls))}
+        preouts: Dict[str, Array] = {}
+        out_names = set(conf.network_outputs) if want_preout else set()
         for name in self.topo:
             vertex = conf.vertices[name]
             srcs = conf.vertex_inputs[name]
@@ -205,7 +217,8 @@ class ComputationGraph:
                 name, vertex, vin, params, variables,
                 train=train, rng=layer_rng.get(name), mask=in_mask,
                 vmasks=vmasks, states=states, new_states=new_states,
-                in_scan=in_scan)
+                in_scan=in_scan,
+                preouts=preouts if name in out_names else None)
             if nv is not None:
                 new_vars[name] = nv
             if (getattr(y, "ndim", None) is not None
@@ -219,18 +232,26 @@ class ComputationGraph:
                 vmasks[name] = in_mask if getattr(y, "ndim", 0) == 3 else None
             if y.ndim == 3:
                 self._current_timesteps[name] = y.shape[1]
+        if want_preout:
+            return acts, new_vars, new_states, preouts
         return acts, new_vars, new_states
 
     # -- loss ------------------------------------------------------------------
     def _loss(self, acts: Dict[str, Array], labels: Sequence[Array],
-              lmasks: Optional[Sequence[Optional[Array]]] = None):
+              lmasks: Optional[Sequence[Optional[Array]]] = None,
+              preouts: Optional[Dict[str, Array]] = None):
         total = jnp.asarray(0.0, jnp.float32)
         for i, out_name in enumerate(self.conf.network_outputs):
             layer_conf = self.conf.vertices[out_name].layer \
                 if isinstance(self.conf.vertices[out_name], LayerVertex) else None
             loss_name = getattr(layer_conf, "loss", None) or "mse"
-            loss_fn = losses_mod.get(loss_name)
-            out = acts[out_name]
+            fused = losses_mod.fused_from_logits(
+                getattr(layer_conf, "activation", None), loss_name)
+            if fused is not None and preouts and out_name in preouts:
+                loss_fn, out = fused, preouts[out_name]
+            else:
+                loss_fn = losses_mod.get(loss_name)
+                out = acts[out_name]
             y = labels[i]
             m = lmasks[i] if lmasks else None
             if out.ndim == 3:
@@ -292,11 +313,11 @@ class ComputationGraph:
         a lax.scan body (remat drops its CSE barriers there)."""
 
         def loss_fn(params, variables, inputs, labels, fmasks, lmasks, rng):
-            acts, new_vars, _ = self._forward_impl(params, variables, inputs,
-                                                   train=True, rng=rng,
-                                                   fmasks=fmasks,
-                                                   in_scan=in_scan)
-            loss = self._loss(acts, labels, lmasks) + self._reg_loss(params)
+            acts, new_vars, _, preouts = self._forward_impl(
+                params, variables, inputs, train=True, rng=rng, fmasks=fmasks,
+                in_scan=in_scan, want_preout=True)
+            loss = (self._loss(acts, labels, lmasks, preouts=preouts)
+                    + self._reg_loss(params))
             return loss, new_vars
 
         def train_step(params, variables, ustates, step, rng, inputs, labels,
@@ -315,10 +336,11 @@ class ComputationGraph:
 
         def loss_fn(params, variables, inputs, labels, fmasks, lmasks, rng,
                     states):
-            acts, new_vars, new_states = self._forward_impl(
+            acts, new_vars, new_states, preouts = self._forward_impl(
                 params, variables, inputs, train=True, rng=rng,
-                fmasks=fmasks, states=states)
-            loss = self._loss(acts, labels, lmasks) + self._reg_loss(params)
+                fmasks=fmasks, states=states, want_preout=True)
+            loss = (self._loss(acts, labels, lmasks, preouts=preouts)
+                    + self._reg_loss(params))
             return loss, (new_vars, new_states)
 
         def train_step(params, variables, ustates, step, rng, inputs, labels,
@@ -579,9 +601,11 @@ class ComputationGraph:
 
         def objective(flat):
             params = unravel(flat)
-            acts, _, _ = self._forward_impl(params, self.variables, inputs,
-                                            train=True, rng=rng, fmasks=fmasks_d)
-            loss = self._loss(acts, labels, lmasks_l) + self._reg_loss(params)
+            acts, _, _, preouts = self._forward_impl(
+                params, self.variables, inputs, train=True, rng=rng,
+                fmasks=fmasks_d, want_preout=True)
+            loss = (self._loss(acts, labels, lmasks_l, preouts=preouts)
+                    + self._reg_loss(params))
             return loss.astype(jnp.float32)
 
         lrs = [v.layer.learning_rate for v in self.conf.vertices.values()
@@ -658,9 +682,11 @@ class ComputationGraph:
         if fmasks is not None:
             fmask_dict = {name: (jnp.asarray(m) if m is not None else None)
                           for name, m in zip(self.conf.network_inputs, fmasks)}
-        acts, _, _ = self._forward_impl(self.params, self.variables, inputs,
-                                        train=False, rng=None, fmasks=fmask_dict)
-        return float(self._loss(acts, labels, lmasks) + self._reg_loss(self.params))
+        acts, _, _, preouts = self._forward_impl(
+            self.params, self.variables, inputs, train=False, rng=None,
+            fmasks=fmask_dict, want_preout=True)
+        return float(self._loss(acts, labels, lmasks, preouts=preouts)
+                     + self._reg_loss(self.params))
 
     def rnn_time_step(self, *inputs) -> List[Array]:
         """Stateful streaming inference (reference rnnTimeStep:1460)."""

@@ -32,9 +32,20 @@ class _LinearLayer(LayerImpl):
         return x @ params["W"] + params["b"]
 
     def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        y, _, v = self.forward_with_preout(params, x, train=train, rng=rng,
+                                           variables=variables, mask=mask)
+        return y, v
+
+    def forward_with_preout(self, params, x, *, train=False, rng=None,
+                            variables=None, mask=None):
+        """forward() that additionally returns the PRE-activation output, so
+        the loss path can use the stable from-logits losses
+        (ops/losses.fused_from_logits) — reproducing the reference's analytic
+        output-layer delta (BaseOutputLayer.java getGradientsAndDelta).
+        forward() delegates here: one definition of the layer math."""
         x = self._dropout(x, train, rng)
-        act = self.activation_fn()
-        return act(self._pre_output(params, x)), variables or {}
+        z = self._pre_output(params, x)
+        return self.activation_fn()(z), z, variables or {}
 
 
 @register_impl("DenseLayer")
@@ -54,12 +65,18 @@ class RnnOutputLayerImpl(_LinearLayer):
     (reference nn/layers/recurrent/RnnOutputLayer.java reshapes 3d<->2d)."""
 
     def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
+        y, _, v = self.forward_with_preout(params, x, train=train, rng=rng,
+                                           variables=variables, mask=mask)
+        return y, v
+
+    def forward_with_preout(self, params, x, *, train=False, rng=None,
+                            variables=None, mask=None):
         x = self._dropout(x, train, rng)
-        act = self.activation_fn()
-        y = act(jnp.einsum("btf,fo->bto", x, params["W"]) + params["b"])
+        z = jnp.einsum("btf,fo->bto", x, params["W"]) + params["b"]
+        y = self.activation_fn()(z)
         if mask is not None:
             y = y * mask[..., None].astype(y.dtype)
-        return y, variables or {}
+        return y, z, variables or {}
 
 
 @register_impl("LossLayer")

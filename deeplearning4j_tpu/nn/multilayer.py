@@ -138,9 +138,12 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------- forward ---
     def _forward_impl(self, params, variables, x, *, train, rng, fmask=None,
                       states=None, upto: Optional[int] = None,
-                      in_scan: bool = False, fuse_pairs: bool = False):
+                      in_scan: bool = False, fuse_pairs: bool = False,
+                      want_preout: bool = False):
         """Pure forward through layers [0, upto). Returns
-        (activations per layer, new variables, new rnn states).
+        (activations per layer, new variables, new rnn states) — plus the
+        final layer's PRE-activation as a 4th element when ``want_preout``
+        (the loss path feeds it to the stable from-logits losses).
 
         ``fuse_pairs`` (set ONLY by the train-step loss path, where acts
         feed nothing but the loss) enables the BN+pool composite; public
@@ -157,6 +160,7 @@ class MultiLayerNetwork:
         acts = []
         new_vars = list(variables)
         new_states: Dict[int, Any] = {}
+        preout = None
         cur = x
         dtype = _compute_dtype_of(conf.conf)
         if dtype != _dtype_of(conf.conf):
@@ -208,6 +212,14 @@ class MultiLayerNetwork:
                                       recurrent=True, in_scan=in_scan)(
                     params[i], cur, state0, rngs[i], lmask_arg)
                 new_states[i] = st
+            elif (want_preout and i == n - 1
+                    and hasattr(impl, "forward_with_preout")):
+                # final layer, loss path: also surface the pre-activation
+                # (cheap — no remat needed, the loss consumes it immediately)
+                y, preout, nv = impl.forward_with_preout(
+                    params[i], cur, train=train, rng=rngs[i],
+                    variables=variables[i], mask=lmask_arg)
+                new_vars[i] = nv
             else:
                 y, nv = remat_forward(impl, train=train, ckpt=ckpt,
                                       recurrent=False, in_scan=in_scan)(
@@ -218,12 +230,20 @@ class MultiLayerNetwork:
             acts.append(y)
             cur = y
             i += 1
+        if want_preout:
+            return acts, new_vars, new_states, preout
         return acts, new_vars, new_states
 
-    def _loss_from_output(self, out: Array, y: Array, lmask: Optional[Array]):
+    def _loss_from_output(self, out: Array, y: Array, lmask: Optional[Array],
+                          preout: Optional[Array] = None):
         out_layer_conf = self.conf.layers[-1]
         loss_name = getattr(out_layer_conf, "loss", None) or "mse"
-        loss_fn = losses_mod.get(loss_name)
+        fused = losses_mod.fused_from_logits(
+            getattr(out_layer_conf, "activation", None), loss_name)
+        if preout is not None and fused is not None:
+            out, loss_fn = preout, fused  # stable from-logits path
+        else:
+            loss_fn = losses_mod.get(loss_name)
         if out.ndim == 3:  # RNN output: flatten time
             o = out.reshape(-1, out.shape[-1])
             t = y.reshape(-1, y.shape[-1])
@@ -278,12 +298,13 @@ class MultiLayerNetwork:
         has_fmask, has_lmask, carry_state = key
 
         def loss_fn(params, variables, x, y, fmask, lmask, rng, states):
-            acts, new_vars, new_states = self._forward_impl(
+            acts, new_vars, new_states, preout = self._forward_impl(
                 params, variables, x, train=True, rng=rng, fmask=fmask,
                 states=states if carry_state else None, in_scan=in_scan,
-                fuse_pairs=True)
+                fuse_pairs=True, want_preout=True)
             out = acts[-1]
-            loss = self._loss_from_output(out, y, lmask) + self._reg_loss(params)
+            loss = (self._loss_from_output(out, y, lmask, preout=preout)
+                    + self._reg_loss(params))
             return loss.astype(jnp.float32), (new_vars, new_states)
 
         def train_step(params, variables, ustates, step, rng, x, y, fmask, lmask, states):
@@ -440,9 +461,10 @@ class MultiLayerNetwork:
 
         def objective(flat):
             params = unravel(flat)
-            acts, _, _ = self._forward_impl(params, self.variables, x,
-                                            train=True, rng=rng, fmask=fmask)
-            loss = self._loss_from_output(acts[-1], y, lmask)
+            acts, _, _, preout = self._forward_impl(
+                params, self.variables, x, train=True, rng=rng, fmask=fmask,
+                want_preout=True)
+            loss = self._loss_from_output(acts[-1], y, lmask, preout=preout)
             return (loss + self._reg_loss(params)).astype(jnp.float32)
 
         lr = self.conf.layers[0].learning_rate if self.conf.layers else 0.1
@@ -691,11 +713,13 @@ class MultiLayerNetwork:
             fmask = getattr(dataset, "features_mask", None)
         else:
             lmask = fmask = None
-        acts, _, _ = self._forward_impl(self.params, self.variables, jnp.asarray(x),
-                                        train=False, rng=None,
-                                        fmask=jnp.asarray(fmask) if fmask is not None else None)
+        acts, _, _, preout = self._forward_impl(
+            self.params, self.variables, jnp.asarray(x), train=False, rng=None,
+            fmask=jnp.asarray(fmask) if fmask is not None else None,
+            want_preout=True)
         loss = self._loss_from_output(acts[-1], jnp.asarray(y),
-                                      jnp.asarray(lmask) if lmask is not None else None)
+                                      jnp.asarray(lmask) if lmask is not None else None,
+                                      preout=preout)
         return float(loss + self._reg_loss(self.params))
 
     # -------------------------------------------------------- rnn stepping ---

@@ -93,6 +93,55 @@ def hinge(labels: Array, preds: Array, mask: Optional[Array] = None) -> Array:
     return _reduce(per_ex, mask)
 
 
+def softmax_mcxent_from_logits(labels: Array, logits: Array,
+                               mask: Optional[Array] = None) -> Array:
+    """Fused softmax + multi-class cross entropy computed from PRE-activation
+    logits: ``-sum(y * log_softmax(z))`` in f32.
+
+    Why this exists: ``mcxent`` on post-softmax probabilities clips at 1e-8,
+    and autodiff through the clip yields exactly ZERO gradient wherever the
+    softmax has saturated (p underflows to 0) — a mis-saturated example can
+    then never be corrected and training wedges (observed: AlexNet-CIFAR10
+    stuck at loss ~6.7 with |grad| ~1e-4 after transient divergence). The
+    reference never has this problem because BaseOutputLayer computes the
+    output-layer delta analytically as (p - y)
+    (LossCalculation / BaseOutputLayer.java getGradientsAndDelta); the
+    logits-space log_softmax formulation reproduces exactly that gradient
+    (d/dz of -y.log_softmax(z) == softmax(z) - y), bounded and never clipped.
+    The facades route (softmax, mcxent/nll) output layers here via
+    ``fused_from_logits``."""
+    z = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(z, axis=-1)
+    per_ex = -jnp.sum(labels.astype(jnp.float32) * logp, axis=-1)
+    return _reduce(per_ex, mask)
+
+
+def sigmoid_xent_from_logits(labels: Array, logits: Array,
+                             mask: Optional[Array] = None) -> Array:
+    """Fused sigmoid + binary cross entropy from logits (stable softplus
+    form); same rationale as softmax_mcxent_from_logits."""
+    z = logits.astype(jnp.float32)
+    y = labels.astype(jnp.float32)
+    per = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return _reduce(jnp.sum(per, axis=-1), mask)
+
+
+# (activation, loss) pairs with a numerically-stable from-logits form. The
+# train/score loss paths consult this and feed PRE-activation outputs.
+_FUSED_FROM_LOGITS: dict[tuple, Callable[..., Array]] = {
+    ("softmax", "mcxent"): softmax_mcxent_from_logits,
+    ("softmax", "negativeloglikelihood"): softmax_mcxent_from_logits,
+    ("softmax", "nll"): softmax_mcxent_from_logits,
+    ("sigmoid", "xent"): sigmoid_xent_from_logits,
+}
+
+
+def fused_from_logits(activation, loss_name) -> Optional[Callable[..., Array]]:
+    if activation is None or loss_name is None:
+        return None
+    return _FUSED_FROM_LOGITS.get((str(activation).lower(), str(loss_name).lower()))
+
+
 LOSSES: dict[str, Callable[..., Array]] = {
     "mse": mse,
     "squared_loss": squared_loss,

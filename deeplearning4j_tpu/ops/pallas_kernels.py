@@ -259,8 +259,13 @@ def _bnap_recompute(x_ref, g_ref, p_ref, act_fn, dact_fn, ch_last):
     xh = (x - mean) * inv
     z = xh * gam + bet
     a = act_fn(z)
-    m = jnp.max(a, axis=(0, 2), keepdims=True)    # (1, W2, 1, D1, D2)
-    eq = (a == m).astype(jnp.float32)
+    # argmax routing must match the FORWARD's pool, which compared the
+    # x.dtype-cast activations (fwd_chain: act(z).astype(x.dtype)) — for
+    # bf16, f32 values that tie after rounding would otherwise route the
+    # whole gradient to one element instead of splitting it (advisor r4)
+    a_c = a.astype(x_ref.dtype).astype(jnp.float32)
+    m = jnp.max(a_c, axis=(0, 2), keepdims=True)  # (1, W2, 1, D1, D2)
+    eq = (a_c == m).astype(jnp.float32)
     cnt = jnp.sum(eq, axis=(0, 2), keepdims=True)  # ties per 2x2 window
     ga = eq * (g / cnt)  # even split among tied maxima — jnp.max's own
     # gradient convention (select-and-scatter routes to one element; the
@@ -466,42 +471,61 @@ def _measure_scan(step_fn, x0, K=32, repeats=3) -> float:
 
 @_eagerly
 def _autotune_bnap(B, H, W, C, dtype, eps, activation) -> bool:
-    """Measure the fused-backward composite against the XLA default on this
-    exact shape (train = fwd+bwd, the real usage) — the same cuDNN
-    find-algorithm discipline as the LSTM/attention seams, but scan-timed
-    (these ops are sub-ms; see _measure_scan)."""
+    """Measure the fused-backward composite against the XLA default IN
+    CONTEXT: sandwiched between a producer conv (whose input/weight grads
+    XLA fuses the BN-backward into) and the train-step chain — the r4
+    ISOLATED probe selected the kernel at 8x8x256 where the full model then
+    measured a 0.5% LOSS, because the custom-call boundary breaks exactly
+    those fusions (VERDICT r4 weak #3 / item 5; docs/ROOFLINE_CNN.md §3).
+    Selection rule: the kernel must win the in-context composite by >=5%
+    (the find-algorithm discipline of CudnnConvolutionHelper.java:48, with
+    the margin covering probe noise), else XLA fallback."""
     import numpy as np
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(B, H, W, C)), dtype)
+    # producer conv: same-C 3x3 SAME, the AlexNet-shaped adjacency whose
+    # backward XLA fuses the composite's dx into
+    xin = jnp.asarray(rng.normal(size=(B, H, W, C)), dtype)
+    wc = jnp.asarray(rng.normal(size=(3, 3, C, C)) * 0.05, dtype)
     gamma = jnp.ones((C,), dtype)
     beta = jnp.zeros((C,), dtype)
+    dn = ("NHWC", "HWIO", "NHWC")
 
-    def ref(x, gamma, beta):
+    def ref(y, gamma, beta):
         return helpers._bn_act_pool_default(
-            x, gamma, beta, eps=eps, activation=activation)[0]
+            y, gamma, beta, eps=eps, activation=activation)[0]
 
-    def train_step(fn):
-        g = jax.grad(lambda xc: jnp.sum(
-            fn(xc, gamma, beta).astype(jnp.float32) ** 2))
+    def train_step(comp):
+        def loss(xc):
+            y = jax.lax.conv_general_dilated(
+                xc, wc, (1, 1), "SAME", dimension_numbers=dn)
+            return jnp.sum(comp(y, gamma, beta).astype(jnp.float32) ** 2)
+        g = jax.grad(loss)
         return lambda xc: xc + 1e-6 * g(xc).astype(xc.dtype)
 
     best = None  # (time, variant)
     for variant in ("hwcb", "hwbc"):
         fused = _get_bnap_fn(eps, activation, variant)
 
-        def pooled_only(xc, g_, b_, fused=fused):
-            return fused(xc, g_, b_)[0]
+        def pooled_only(y, g_, b_, fused=fused):
+            return fused(y, g_, b_)[0]
 
         try:
-            t = _measure_scan(train_step(pooled_only), x)
+            t = _measure_scan(train_step(pooled_only), xin)
         except Exception:
             continue
         if best is None or t < best[0]:
             best = (t, variant)
     if best is None:
         return False
-    t_r = _measure_scan(train_step(ref), x)
-    return best[1] if best[0] < t_r * 0.95 else False
+    try:
+        t_r = _measure_scan(train_step(ref), xin)
+    except Exception:
+        # reference measurement failed transiently: no walkover for a
+        # net-negative-prone kernel — fall back to XLA (advisor r4; the
+        # attention seam walks over instead because dense XLA genuinely
+        # cannot compile at its failing shapes)
+        return False
+    return best[1] if best[0] * 1.05 < t_r else False
 
 
 def bn_act_pool_pallas(x, gamma, beta, *, eps=1e-5, activation="relu"):

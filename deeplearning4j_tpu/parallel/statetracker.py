@@ -60,40 +60,93 @@ class TrainingStateTracker:
         self._workers: Dict[str, bool] = self._load_workers()
 
     # -- worker lifecycle (reference :184-199) ---------------------------------
-    def _workers_path(self) -> Path:
-        return self.dir / "workers.json"
+    # One FILE PER WORKER, merged on read. The roster lives on a shared
+    # checkpoint substrate (NFS / GCS-fuse) where flock is unreliable
+    # (gcsfuse: silent no-op; NFS: mount-dependent), so any cross-host
+    # read-merge-write of a single roster file can lose registrations.
+    # Per-worker files need no cross-host mutual exclusion at all: distinct
+    # workers touch distinct files, and same-worker mutations are owned by
+    # that worker (or the master that declared it dead) with atomic
+    # last-writer-wins via os.replace. (Advisor r4, severity medium.)
+    def _workers_dir(self) -> Path:
+        return self.dir / "workers"
+
+    @staticmethod
+    def _worker_file_stem(worker_id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in worker_id)
+        if safe != worker_id:  # collision-proof the sanitized name
+            import hashlib
+            safe += "-" + hashlib.sha1(worker_id.encode()).hexdigest()[:8]
+        return safe
 
     def _load_workers(self) -> Dict[str, bool]:
-        try:
-            with open(self._workers_path()) as fh:
-                return {str(k): bool(v) for k, v in json.load(fh).items()}
+        merged: Dict[str, bool] = {}
+        try:  # legacy pre-r5 single-file roster, lowest precedence
+            with open(self.dir / "workers.json") as fh:
+                merged.update({str(k): bool(v)
+                               for k, v in json.load(fh).items()})
         except (OSError, ValueError):
-            return {}
+            pass
+        wd = self._workers_dir()
+        if wd.is_dir():
+            for f in sorted(wd.glob("*.json")):
+                try:
+                    with open(f) as fh:
+                        rec = json.load(fh)
+                    merged[str(rec["id"])] = bool(rec["enabled"])
+                except (OSError, ValueError, KeyError):
+                    continue  # torn write: skip, the owner will rewrite
+        return merged
 
     def _mutate_workers(self, worker_id: str, value, *,
                         keep_existing: bool) -> None:
-        """Read-merge-write under an exclusive flock so concurrent trackers
-        on the shared directory (multiple pod hosts registering at startup)
-        cannot clobber each other's registrations."""
-        import fcntl
-        lock_path = self.dir / "workers.lock"
-        with open(lock_path, "w") as lock:
-            fcntl.flock(lock, fcntl.LOCK_EX)
-            try:
-                on_disk = self._load_workers()  # freshest shared state wins
-                if keep_existing:
-                    on_disk.setdefault(worker_id, value)
-                else:
-                    on_disk[worker_id] = value
-                self._workers = on_disk
-                tmp = self._workers_path().with_suffix(".json.tmp")
+        wd = self._workers_dir()
+        wd.mkdir(parents=True, exist_ok=True)
+        path = wd / f"{self._worker_file_stem(worker_id)}.json"
+        payload = json.dumps({"id": worker_id, "enabled": bool(value)})
+        if keep_existing:
+            # add_worker must never OVERWRITE concurrent state: a master
+            # disabling this worker races the worker re-registering. Respect
+            # the merged roster (covers the legacy single-file format), then
+            # create with O_EXCL — if the file exists (or appears between
+            # check and create), the existing record wins; if we win the
+            # create, a concurrent disable's os.replace lands after and
+            # wins. Both orders converge to the disable — the guarantee the
+            # old flock'd read-merge-write gave on substrates where flock
+            # actually works, now without needing it.
+            if worker_id not in self._load_workers():
+                # write the FULL record to a unique tmp first, then claim
+                # the name with os.link (fails if present, like O_EXCL, but
+                # the visible file always has complete content): a crash
+                # between a direct O_EXCL create and its write would leave
+                # a permanent empty poison file this worker could never
+                # re-register past
+                tmp = path.with_suffix(f".add.{os.getpid()}.{id(self):x}")
                 with open(tmp, "w") as fh:
-                    json.dump(self._workers, fh)
+                    fh.write(payload)
                     fh.flush()
                     os.fsync(fh.fileno())
-                os.replace(tmp, self._workers_path())
-            finally:
-                fcntl.flock(lock, fcntl.LOCK_UN)
+                try:
+                    os.link(tmp, path)
+                except FileExistsError:
+                    pass  # a concurrent record exists: it wins
+                finally:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+        else:
+            # enable/disable: atomic last-writer-wins overwrite; unique tmp
+            # name so two hosts mutating the same worker cannot clobber
+            # each other's in-flight tmp before the rename
+            tmp = path.with_suffix(f".tmp.{os.getpid()}.{id(self):x}")
+            with open(tmp, "w") as fh:
+                fh.write(payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        self._workers = self._load_workers()
 
     def add_worker(self, worker_id: str) -> None:
         self._mutate_workers(worker_id, True, keep_existing=True)

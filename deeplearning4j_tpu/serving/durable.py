@@ -29,6 +29,13 @@ from typing import Callable, List, Optional, Sequence
 
 _MAGIC = 0xD14A
 _HDR = struct.Struct("<HII")  # magic, payload_len, crc32(payload)
+#: protocol bound on a single frame's payload. The producer enforces it, so
+#: a parsed header claiming more is by definition garbage from a mid-frame
+#: resync — the consumer can skip it immediately instead of waiting for
+#: bytes that will never arrive. (Logs written before this bound existed
+#: could in principle hold larger frames; none were ever produced by this
+#: codebase — records are JSON rows — so no version guard is kept.)
+MAX_FRAME = 64 * 1024 * 1024
 
 
 class DurableLogProducer:
@@ -38,10 +45,123 @@ class DurableLogProducer:
 
     def __init__(self, path: str, fsync_every: int = 1):
         self.path = path
-        self._truncate_torn_tail(path)
-        self._f = open(path, "ab")
+        # ENFORCE single-writer (advisor r4): a restarting producer
+        # truncates the torn tail, which would corrupt a still-live
+        # producer's in-flight frame if two ever shared a partition file.
+        # O_CREAT|O_EXCL pid lockfile (works on NFS, unlike flock); stale
+        # locks (dead pid on THIS host) are broken automatically.
+        self._lock_path = path + ".producer.lock"
+        self._acquire_writer_lock()
+        try:
+            self._truncate_torn_tail(path)
+            self._f = open(path, "ab")
+        except BaseException:
+            self._release_writer_lock()
+            raise
         self._fsync_every = max(1, fsync_every)
         self._since_sync = 0
+
+    def _acquire_writer_lock(self) -> None:
+        import socket
+        host = socket.gethostname()
+        unreadable_streak = 0
+        for _attempt in range(4):
+            try:
+                fd = os.open(self._lock_path,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, json.dumps({"pid": os.getpid(),
+                                         "host": host}).encode())
+                os.close(fd)
+                return
+            except FileExistsError:
+                try:
+                    with open(self._lock_path) as fh:
+                        rec = json.loads(fh.read() or "{}")
+                    if not isinstance(rec, dict):
+                        rec = {}
+                    holder, lhost = int(rec.get("pid", 0)), rec.get("host")
+                except (OSError, ValueError):
+                    holder, lhost = 0, None
+                # liveness is only decidable for a holder on THIS host
+                # (pids are host-local); a foreign host's lock is honored —
+                # breaking it could let two live producers truncate each
+                # other's torn tails on the shared filesystem. A genuinely
+                # dead foreign holder needs a manual unlink (documented
+                # failure mode, same as any lease-less lockfile).
+                stale = False
+                if lhost == host and holder > 0:
+                    try:
+                        os.kill(holder, 0)
+                    except ProcessLookupError:
+                        stale = True
+                    except PermissionError:
+                        pass
+                elif holder == 0 and lhost is None:
+                    # empty/unparsable record: might be a holder BETWEEN
+                    # O_EXCL create and write — give it a grace period and
+                    # only call it stale if it stays unreadable
+                    unreadable_streak += 1
+                    if unreadable_streak < 2:
+                        time.sleep(0.2)
+                        continue
+                    stale = True
+                if not stale:
+                    raise RuntimeError(
+                        f"DurableLogProducer: {self.path} is locked by "
+                        f"producer pid {holder} on host {lhost!r} "
+                        f"(single-writer is enforced; use distinct "
+                        f"partition files for concurrent producers, or "
+                        f"remove {self._lock_path} if the holder is "
+                        f"confirmed dead)")
+                self._break_stale_lock(holder, lhost)
+        raise RuntimeError(
+            f"DurableLogProducer: could not acquire {self._lock_path}")
+
+    def _break_stale_lock(self, holder: int, lhost) -> None:
+        """Remove a lock judged stale, SERIALIZED through a breaker lock so
+        two concurrent breakers cannot leapfrog each other (without this, B
+        can unlink the lock a faster breaker C already re-created, admitting
+        two live producers). Under the breaker lock the main lock's content
+        is re-verified before the unlink, so only the exact record that was
+        judged stale is ever removed. A breaker that crashes mid-break
+        leaves the breaker lock behind: breaking disables (loud error, no
+        corruption) until the operator removes it."""
+        breaker = self._lock_path + ".breaker"
+        try:
+            bfd = os.open(breaker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            raise RuntimeError(
+                f"DurableLogProducer: stale lock {self._lock_path} but "
+                f"another breaker is active (or crashed) holding {breaker}; "
+                f"remove it manually if no producer start is in flight")
+        try:
+            os.close(bfd)
+            try:
+                with open(self._lock_path) as fh:
+                    rec = json.loads(fh.read() or "{}")
+                if not isinstance(rec, dict):
+                    rec = {}
+            except FileNotFoundError:
+                return  # already broken by the previous breaker
+            except (OSError, ValueError):
+                rec = {}
+            if (int(rec.get("pid", 0)), rec.get("host")) == (holder, lhost):
+                try:
+                    os.unlink(self._lock_path)
+                except OSError:
+                    pass
+            # else: the lock changed hands since we judged it — leave it
+        finally:
+            try:
+                os.unlink(breaker)
+            except OSError:
+                pass
+
+    def _release_writer_lock(self) -> None:
+        try:
+            os.unlink(self._lock_path)
+        except OSError:
+            pass
 
     @staticmethod
     def _truncate_torn_tail(path: str) -> None:
@@ -73,6 +193,10 @@ class DurableLogProducer:
 
     def send(self, record) -> None:
         payload = json.dumps(record).encode()
+        if len(payload) > MAX_FRAME:
+            raise ValueError(
+                f"record serializes to {len(payload)} bytes > MAX_FRAME "
+                f"{MAX_FRAME} (split it across records)")
         frame = _HDR.pack(_MAGIC, len(payload), zlib.crc32(payload)) + payload
         self._f.write(frame)
         self._since_sync += 1
@@ -89,6 +213,7 @@ class DurableLogProducer:
     def close(self) -> None:
         self.flush()
         self._f.close()
+        self._release_writer_lock()
 
 
 class DurableLogConsumer:
@@ -105,6 +230,8 @@ class DurableLogConsumer:
         self.cursor_path = f"{path}.{group}.cursor"
         self.offset = self._load_cursor()
         self._pending_offset = self.offset
+        self.corrupt_bytes_skipped = 0  # observability: resync cost so far
+        self._badcrc_at = -1  # complete-frame CRC failure awaiting re-check
 
     def _load_cursor(self) -> int:
         try:
@@ -141,18 +268,64 @@ class DurableLogConsumer:
                 if len(hdr) < _HDR.size:
                     break
                 magic, ln, crc = _HDR.unpack(hdr)
-                if magic != _MAGIC:
-                    # corrupt mid-log byte (should not happen: appends are
-                    # sequential); skip forward one byte to resync
-                    self._pending_offset += 1
-                    f.seek(self._pending_offset)
+                if magic != _MAGIC or ln > MAX_FRAME:
+                    # corrupt mid-log byte, or a resync landed on garbage
+                    # that parses as a header with an impossible length
+                    # (the producer enforces MAX_FRAME, so such a frame can
+                    # never complete — waiting would wedge the group,
+                    # advisor r4); scan ahead for the next magic to resync
+                    self._resync(f)
                     continue
                 payload = f.read(ln)
-                if len(payload) < ln or zlib.crc32(payload) != crc:
-                    break  # torn tail — wait for the producer to finish
+                if len(payload) < ln:
+                    # genuine torn tail (bytes missing): WAIT — the live
+                    # producer completes it, and a crashed producer's
+                    # restart truncates it before appending
+                    # (_truncate_torn_tail), which re-syncs us via the
+                    # size check above
+                    break
+                if zlib.crc32(payload) != crc:
+                    # COMPLETE frame with a bad CRC. Appends never rewrite
+                    # bytes, so real corruption can never become valid —
+                    # but on weakly-coherent shared filesystems (NFS /
+                    # gcsfuse, the stated substrate) a cross-host reader
+                    # can transiently see the extended size with stale
+                    # payload pages. poll() reopens the file each call
+                    # (close-to-open coherence revalidates caches), so:
+                    # first sighting waits one poll; the SAME offset
+                    # failing again across a reopen is deterministic
+                    # corruption — resync past it (counted, advisor r4).
+                    if self._pending_offset == self._badcrc_at:
+                        self._badcrc_at = -1
+                        self._resync(f)
+                        continue
+                    self._badcrc_at = self._pending_offset
+                    break
+                self._badcrc_at = -1
                 out.append(json.loads(payload.decode()))
                 self._pending_offset += _HDR.size + ln
         return out
+
+    _MAGIC_BYTES = struct.pack("<H", _MAGIC)
+    RESYNC_CHUNK = 1 << 20
+
+    def _resync(self, f) -> None:
+        """Advance _pending_offset past a corrupt region to the next magic
+        marker (bulk scan — a byte-at-a-time loop through a multi-MB bad
+        region would stall the consumer for minutes)."""
+        start = self._pending_offset + 1
+        f.seek(start)
+        buf = f.read(self.RESYNC_CHUNK)
+        idx = buf.find(self._MAGIC_BYTES)
+        if idx < 0:
+            # no magic in the window: skip it all (keep 1 byte of overlap —
+            # a marker could straddle the chunk boundary)
+            jump = max(len(buf) - 1, 1)
+        else:
+            jump = idx
+        self._pending_offset = start + jump
+        self.corrupt_bytes_skipped += 1 + jump
+        f.seek(self._pending_offset)
 
     def lag(self) -> int:
         try:

@@ -1,0 +1,52 @@
+"""The bench regression gate must catch the round-4 AlexNet divergence.
+
+VERDICT r4 item 2: BENCH_FLOORS.json gated throughput only, so AlexNet's
+loss rising 3.286 -> 3.775 produced `regressions: []`. The gate now has
+loss_last ceilings AND a built-in loss_last < loss_first invariant; this
+test replays the actual r4 rows against the committed floors.
+"""
+import importlib.util
+import sys
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", Path(__file__).resolve().parent.parent / "bench.py")
+bench = importlib.util.module_from_spec(_spec)
+sys.modules.setdefault("bench", bench)
+_spec.loader.exec_module(bench)
+
+
+def test_r4_alexnet_divergence_is_caught():
+    """The exact committed r4 rows (BENCH_r04 / /tmp/bench_r4_try1) must
+    now trip the gate, two ways: ceiling AND invariant."""
+    r4 = {
+        "alexnet_cifar10": {"mfu": 0.254, "loss_first": 3.286,
+                            "loss_last": 3.775},
+    }
+    regs = bench.check_floors(r4)
+    assert any("DIVERGED" in r for r in regs), regs
+    assert any("loss_last=3.775 > ceiling" in r for r in regs), regs
+
+
+def test_healthy_rows_pass():
+    healthy = {
+        "alexnet_cifar10": {"mfu": 0.25, "loss_first": 3.3, "loss_last": 0.07},
+        "lenet_mnist": {"examples_per_sec": 600000.0, "loss_first": 2.3,
+                        "loss_last": 0.05},
+        "tsne_50k": {"iter_ms": 50.0, "knn_build_s": 30.0},
+    }
+    assert bench.check_floors(healthy) == []
+
+
+def test_tsne_knn_build_regression_is_caught():
+    """Weak #6: the r3->r4 knn_build_s 22.5->32.0 regression had no floor;
+    a further slide past 45 s must now be flagged."""
+    rows = {"tsne_50k": {"iter_ms": 50.0, "knn_build_s": 60.0}}
+    regs = bench.check_floors(rows)
+    assert any("knn_build_s" in r for r in regs), regs
+
+
+def test_renamed_field_is_reported_not_silently_skipped():
+    rows = {"alexnet_cifar10": {"mfu_renamed": 0.25}}
+    regs = bench.check_floors(rows)
+    assert any("missing/non-numeric" in r for r in regs), regs
