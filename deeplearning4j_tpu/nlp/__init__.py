@@ -10,6 +10,7 @@ from .sentence_iterator import (BasicLineIterator, CollectionSentenceIterator,
                                 SentenceIterator)
 from .vocab import VocabCache, VocabConstructor, build_huffman
 from .invertedindex import InvertedIndex
+from .diskindex import DiskInvertedIndex
 from .trees import Tree, parse_tree, parse_trees
 from .word2vec import InMemoryLookupTable, SequenceVectors, Word2Vec
 from .glove import AbstractCoOccurrences, Glove
@@ -24,7 +25,8 @@ __all__ = [
     "remove_stop_words", "SentenceIterator", "BasicLineIterator",
     "CollectionSentenceIterator", "LabelAwareSentenceIterator",
     "LabelledCollectionSentenceIterator", "VocabCache", "VocabConstructor",
-    "build_huffman", "InvertedIndex", "Tree", "parse_tree", "parse_trees",
+    "build_huffman", "InvertedIndex", "DiskInvertedIndex", "Tree",
+    "parse_tree", "parse_trees",
     "SequenceVectors", "Word2Vec", "InMemoryLookupTable",
     "AbstractCoOccurrences", "Glove", "ParagraphVectors",
     "BagOfWordsVectorizer", "TfidfVectorizer", "serializer",

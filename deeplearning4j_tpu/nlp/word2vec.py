@@ -237,12 +237,24 @@ class SequenceVectors:
         GROUP = 512  # sequences per vectorized _pairs call
 
         producer_error: list = []
+        stop = threading.Event()  # consumer failed: stop generating
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def _produce():
             try:
                 bc = np.zeros(0, np.int32)
                 bt = np.zeros(0, np.int32)
                 for gi in range(0, len(epoch_seqs), GROUP):
+                    if stop.is_set():
+                        return  # consumer died: don't pair-gen the rest
                     cg, tg = self._pairs(epoch_seqs[gi:gi + GROUP], prng)
                     if cg.size == 0:
                         continue
@@ -250,11 +262,12 @@ class SequenceVectors:
                     bc = np.concatenate([bc, cg[perm]])
                     bt = np.concatenate([bt, tg[perm]])
                     while bc.size >= chunk_pairs:
-                        q.put((bc[:chunk_pairs], bt[:chunk_pairs],
-                               chunk_pairs))
+                        if not _put((bc[:chunk_pairs], bt[:chunk_pairs],
+                                     chunk_pairs)):
+                            return
                         bc, bt = bc[chunk_pairs:], bt[chunk_pairs:]
                 if bc.size:
-                    q.put((bc, bt, int(bc.size)))
+                    _put((bc, bt, int(bc.size)))
             except BaseException as e:  # surfaced to the consumer: a
                 # swallowed producer failure would silently end the epoch
                 # early and report success on partially-trained data
@@ -269,10 +282,11 @@ class SequenceVectors:
             seen, last_loss = self._consume_stream(q, seen, total_pairs,
                                                    last_loss)
         finally:
-            # unblock a producer stuck in q.put on the bounded queue when
-            # the CONSUMER failed (device error mid-epoch): drain to the
-            # sentinel so the thread exits instead of pinning corpus-sized
-            # buffers for the process lifetime
+            # consumer done or FAILED: stop the producer (so it doesn't
+            # pair-gen the rest of a large corpus just to be thrown away)
+            # and drain to the sentinel so a blocked q.put unblocks instead
+            # of pinning corpus-sized buffers for the process lifetime
+            stop.set()
             while True:
                 try:
                     if q.get_nowait() is None:

@@ -85,8 +85,16 @@ class LossLayerImpl(LayerImpl):
         return False
 
     def forward(self, params, x, *, train=False, rng=None, variables=None, mask=None):
-        act = self.activation_fn()
-        return act(x), variables or {}
+        y, _, v = self.forward_with_preout(params, x, train=train, rng=rng,
+                                           variables=variables, mask=mask)
+        return y, v
+
+    def forward_with_preout(self, params, x, *, train=False, rng=None,
+                            variables=None, mask=None):
+        """LossLayer's pre-activation IS its input — exposing it keeps the
+        stable from-logits loss path (the saturated-softmax wedge fix)
+        working for nets that end in LossLayer(softmax, mcxent)."""
+        return self.activation_fn()(x), x, variables or {}
 
 
 @register_impl("ActivationLayer")

@@ -130,12 +130,29 @@ class TrainingStateTracker:
                 try:
                     os.link(tmp, path)
                 except FileExistsError:
-                    pass  # a concurrent record exists: it wins
-                finally:
+                    # a record exists: it wins — unless it is an EMPTY/torn
+                    # leftover of a crashed add (a poison file nothing would
+                    # ever rewrite): heal it with our complete record
                     try:
-                        os.unlink(tmp)
+                        if os.path.getsize(path) == 0:
+                            os.replace(tmp, path)
+                            tmp = None
                     except OSError:
                         pass
+                except OSError:
+                    # hard links unsupported (gcsfuse): fall back to the
+                    # atomic-visibility rename. The lost property is only
+                    # create-if-absent firstness for simultaneous adds of
+                    # the SAME new worker with different values — add
+                    # always writes enabled=True, so both writers agree
+                    os.replace(tmp, path)
+                    tmp = None
+                finally:
+                    if tmp is not None:
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
         else:
             # enable/disable: atomic last-writer-wins overwrite; unique tmp
             # name so two hosts mutating the same worker cannot clobber

@@ -225,6 +225,11 @@ class DurableLogConsumer:
     atomically (tmp + rename + fsync) — the same torn-write discipline as
     parallel/statetracker.py checkpoints."""
 
+    #: how long a complete-but-CRC-failing frame may stay bad before it is
+    #: declared corruption rather than a stale shared-fs read (NFS acregmin
+    #: keeps pages/attrs stale up to ~3s with a live writer)
+    BADCRC_GRACE_S = 5.0
+
     def __init__(self, path: str, group: str = "default"):
         self.path = path
         self.cursor_path = f"{path}.{group}.cursor"
@@ -232,6 +237,7 @@ class DurableLogConsumer:
         self._pending_offset = self.offset
         self.corrupt_bytes_skipped = 0  # observability: resync cost so far
         self._badcrc_at = -1  # complete-frame CRC failure awaiting re-check
+        self._badcrc_since = 0.0
 
     def _load_cursor(self) -> int:
         try:
@@ -292,14 +298,20 @@ class DurableLogConsumer:
                     # can transiently see the extended size with stale
                     # payload pages. poll() reopens the file each call
                     # (close-to-open coherence revalidates caches), so:
-                    # first sighting waits one poll; the SAME offset
-                    # failing again across a reopen is deterministic
-                    # corruption — resync past it (counted, advisor r4).
+                    # the first sighting starts a grace clock; only the
+                    # SAME offset still failing after BADCRC_GRACE_S
+                    # (sized past NFS attribute-cache staleness, acregmin
+                    # default 3s) is deterministic corruption — resync
+                    # past it (counted, advisor r4).
                     if self._pending_offset == self._badcrc_at:
-                        self._badcrc_at = -1
-                        self._resync(f)
-                        continue
-                    self._badcrc_at = self._pending_offset
+                        if (time.monotonic() - self._badcrc_since
+                                >= self.BADCRC_GRACE_S):
+                            self._badcrc_at = -1
+                            self._resync(f)
+                            continue
+                    else:
+                        self._badcrc_at = self._pending_offset
+                        self._badcrc_since = time.monotonic()
                     break
                 self._badcrc_at = -1
                 out.append(json.loads(payload.decode()))

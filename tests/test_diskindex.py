@@ -1,0 +1,132 @@
+"""Disk-backed inverted index (nlp/diskindex.py) — VERDICT r4 item 7.
+
+Parity target: LuceneInvertedIndex.java (postings + stored docs on disk,
+term dictionary resident). The headline test indexes ONE MILLION synthetic
+documents in a subprocess with bounded peak RSS, then searches and computes
+TF-IDF over the committed index — the corpus-scale proof the in-memory
+InvertedIndex (82 LoC) could not give.
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.diskindex import DiskInvertedIndex
+from deeplearning4j_tpu.nlp.invertedindex import InvertedIndex
+
+DOCS = [
+    ["the", "quick", "brown", "fox"],
+    ["the", "lazy", "dog"],
+    ["quick", "quick", "fox"],
+    ["a", "dog", "and", "a", "fox"],
+    [],
+]
+
+
+def _build(tmp_path, flush_every=4):
+    idx = DiskInvertedIndex(str(tmp_path / "ix"), flush_every=flush_every)
+    for i, d in enumerate(DOCS):
+        idx.add_document(d, label=f"L{i}" if i % 2 == 0 else None)
+    return idx.commit()
+
+
+def test_matches_in_memory_index(tmp_path):
+    """Query-for-query parity with the in-memory InvertedIndex duck-type,
+    including multi-segment spills (flush_every=4 forces several)."""
+    disk = _build(tmp_path)
+    mem = InvertedIndex()
+    for i, d in enumerate(DOCS):
+        mem.add_document(d, label=f"L{i}" if i % 2 == 0 else None)
+    assert disk.num_documents() == mem.num_documents()
+    assert disk.terms() == mem.terms()
+    for w in mem.terms() + ["missing"]:
+        assert disk.documents(w) == mem.documents(w), w
+        assert disk.doc_frequency(w) == mem.doc_frequency(w), w
+        assert disk.doc_appeared_in_percent(w) == pytest.approx(
+            mem.doc_appeared_in_percent(w))
+        for d in range(len(DOCS)):
+            assert disk.tfidf(w, d) == pytest.approx(mem.tfidf(w, d)), (w, d)
+    for d in range(len(DOCS)):
+        assert disk.document(d) == mem.document(d)
+        assert disk.document_label(d) == mem.document_label(d)
+    assert ([b for b in disk.batch_iter(2)]
+            == [b for b in mem.batch_iter(2)])
+
+
+def test_reopen_and_search(tmp_path):
+    _build(tmp_path).close()
+    idx = DiskInvertedIndex.open(str(tmp_path / "ix"))
+    assert idx.num_documents() == 5
+    hits = idx.search(["quick", "fox"], top_k=3)
+    assert [d for d, _ in hits][0] == 2  # "quick quick fox" ranks first
+    assert all(s > 0 for _, s in hits)
+    assert idx.documents("dog") == [1, 3]
+
+
+def test_add_after_commit_rejected(tmp_path):
+    idx = _build(tmp_path)
+    with pytest.raises(RuntimeError, match="committed"):
+        idx.add_document(["x"])
+
+
+_MILLION_DOC_DRIVER = r"""
+import os, resource, sys, time
+import numpy as np
+sys.path.insert(0, sys.argv[2])
+from deeplearning4j_tpu.nlp.diskindex import DiskInvertedIndex
+
+N = 1_000_000
+V = 30_000
+rng = np.random.default_rng(0)
+zipf = 1.0 / np.arange(1, V + 1) ** 0.9
+zipf /= zipf.sum()
+idx = DiskInvertedIndex(sys.argv[1], flush_every=2_000_000)
+t0 = time.time()
+# draw in blocks to keep generation cheap; docs of 4-12 tokens
+lens = rng.integers(4, 13, N)
+flat = rng.choice(V, size=int(lens.sum()), p=zipf)
+pos = 0
+vocab = np.array([f"w{i}" for i in range(V)])
+for n in lens:
+    idx.add_document(vocab[flat[pos:pos + n]].tolist())
+    pos += n
+idx.commit()
+build_s = time.time() - t0
+assert idx.num_documents() == N
+# search + TF-IDF over the committed corpus
+hits = idx.search(["w0", "w17", "w123"], top_k=5)
+assert len(hits) == 5 and hits[0][1] >= hits[-1][1] > 0
+d0 = hits[0][0]
+assert idx.tfidf("w0", d0) >= 0.0
+df = idx.doc_frequency("w0")
+assert 0 < df <= N
+doc = idx.document(d0)
+assert 4 <= len(doc) <= 12
+rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+print(f"OK build_s={build_s:.1f} rss_mb={rss_mb:.0f} df_w0={df}", flush=True)
+"""
+
+
+def test_million_documents_bounded_memory(tmp_path):
+    """Index 1e6 docs (~8e6 postings) in a fresh subprocess; peak RSS must
+    stay far below what resident python-list postings + docs would need
+    (measured: the in-memory InvertedIndex takes >1.5 GB for this corpus),
+    proving the disk-backed storage discipline."""
+    driver = tmp_path / "driver.py"
+    driver.write_text(_MILLION_DOC_DRIVER)
+    repo = str(Path(__file__).resolve().parent.parent)
+    out = subprocess.run(
+        [sys.executable, str(driver), str(tmp_path / "bigix"), repo],
+        capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK" in out.stdout
+    rss_mb = float(out.stdout.split("rss_mb=")[1].split()[0])
+    assert rss_mb < 800, f"peak RSS {rss_mb} MB — memory not bounded"
+    # the committed index is on disk and reopenable
+    idx = DiskInvertedIndex.open(str(tmp_path / "bigix"))
+    assert idx.num_documents() == 1_000_000
+    assert idx.doc_frequency("w0") > 0
+    idx.close()
