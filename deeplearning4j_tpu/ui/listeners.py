@@ -43,16 +43,21 @@ class HistogramIterationListener(IterationListener):
         if iteration % self.frequency != 0:
             return
         params = {}
+        magnitudes = {}
         param_iter = (model.params.items() if isinstance(model.params, dict)
                       else enumerate(model.params))
         for i, lp in param_iter:
             for name, arr in lp.items():
-                params[f"{i}_{name}"] = _histogram(np.asarray(arr, np.float32),
-                                                   self.bins)
+                a = np.asarray(arr, np.float32)
+                params[f"{i}_{name}"] = _histogram(a, self.bins)
+                # the reference's "Mean Magnitudes: Parameters" time series
+                # (HistogramIterationListener's meanMagnitudes bean)
+                magnitudes[f"{i}_{name}"] = float(np.abs(a).mean())
         payload = {
             "iteration": iteration,
             "score": float(model.score_),
             "parameters": params,
+            "mean_magnitudes": magnitudes,
         }
         _post(f"{self.server_url}/weights/update?sid={self.session_id}", payload)
 
@@ -67,16 +72,27 @@ class FlowIterationListener(IterationListener):
         self._posted = False
 
     def _model_info(self, model) -> dict:
+        def count(lp) -> int:
+            # np.size reads shape metadata only — no device->host copy
+            return int(sum(np.size(a) for a in lp.values())) \
+                if isinstance(lp, dict) else 0
+
         layers = []
         if hasattr(model.conf, "layers"):  # MultiLayerNetwork
             for i, lc in enumerate(model.conf.layers):
                 layers.append({"name": f"layer_{i}",
                                "type": type(lc).__name__,
-                               "inputs": [f"layer_{i-1}"] if i else ["input"]})
-        else:  # ComputationGraph
-            for name, v in model.conf.vertices.items():
+                               "inputs": [f"layer_{i-1}"] if i else ["input"],
+                               "n_params": count(model.params[i])})
+        else:  # ComputationGraph: emit in TOPOLOGICAL order — the flow
+            # page places each vertex below its inputs, so producers must
+            # appear before consumers (insertion order isn't trusted
+            # anywhere else in the graph code either)
+            for name in model.topo:
+                v = model.conf.vertices[name]
                 layers.append({"name": name, "type": type(v).__name__,
-                               "inputs": model.conf.vertex_inputs[name]})
+                               "inputs": model.conf.vertex_inputs[name],
+                               "n_params": count(model.params.get(name, {}))})
         return {"layers": layers}
 
     def iteration_done(self, model, iteration):
@@ -87,21 +103,26 @@ class FlowIterationListener(IterationListener):
 
 
 class ConvolutionalIterationListener(IterationListener):
-    """Activation statistics for conv layers (the reference renders activation
-    images; here per-channel activation stats are posted with the histograms)."""
+    """Conv-layer activation images + per-layer stats (the reference's
+    ConvolutionalIterationListener renders activation grids in the UI;
+    here the first example's channels are normalized to [0,1] grids and
+    POSTed to /activations/update, which the /activations page renders as
+    grayscale heatmaps)."""
 
     def __init__(self, server_url: str, probe_input, session_id: str = "default",
-                 frequency: int = 10):
+                 frequency: int = 10, max_channels: int = 16):
         self.server_url = server_url.rstrip("/")
         self.session_id = session_id
         self.frequency = max(1, frequency)
         self.probe_input = probe_input
+        self.max_channels = max_channels
 
     def iteration_done(self, model, iteration):
         if iteration % self.frequency != 0:
             return
         acts = model.feed_forward(self.probe_input)
         stats = {}
+        layers = []
         for i, a in enumerate(acts[1:]):
             arr = np.asarray(a, np.float32)
             if arr.ndim == 4:  # conv activations NHWC
@@ -109,9 +130,19 @@ class ConvolutionalIterationListener(IterationListener):
                     "mean": float(arr.mean()), "std": float(arr.std()),
                     "channels": int(arr.shape[-1]),
                 }
-        _post(f"{self.server_url}/weights/update?sid={self.session_id}_conv",
+                ex = arr[0]  # first example: [H, W, C]
+                # normalize PER CHANNEL — one wide-range channel would
+                # otherwise wash every other tile out to uniform gray
+                lo = ex.min(axis=(0, 1), keepdims=True)
+                hi = ex.max(axis=(0, 1), keepdims=True)
+                norm = (ex - lo) / np.maximum(hi - lo, 1e-9)
+                chans = [np.round(norm[:, :, c], 3).tolist()
+                         for c in range(min(ex.shape[-1], self.max_channels))]
+                layers.append({"layer": i, "h": int(ex.shape[0]),
+                               "w": int(ex.shape[1]), "channels": chans})
+        _post(f"{self.server_url}/activations/update?sid={self.session_id}",
               {"iteration": iteration, "score": float(model.score_),
-               "activations": stats})
+               "stats": stats, "layers": layers})
 
 
 def post_tsne(server_url: str, coords, labels=None,

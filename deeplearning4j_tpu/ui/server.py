@@ -29,8 +29,9 @@ _DASHBOARD = """<!DOCTYPE html>
 <html><head><title>dl4j-tpu training UI</title></head>
 <body style="font-family:sans-serif">
 <h2>dl4j-tpu training UI</h2>
-<p><a href="/tsne">t-SNE view</a> | <a href="/nearestneighbors">nearest
-neighbors</a></p>
+<p><a href="/weights">weights</a> | <a href="/activations">activations</a> |
+<a href="/flow">flow</a> | <a href="/tsne">t-SNE view</a> |
+<a href="/nearestneighbors">nearest neighbors</a></p>
 <div id="sessions"></div>
 <canvas id="chart" width="900" height="320" style="border:1px solid #ccc"></canvas>
 <script>
@@ -56,6 +57,158 @@ async function refresh() {
 setInterval(refresh, 2000); refresh();
 </script></body></html>"""
 
+
+_WEIGHTS_PAGE = """<!DOCTYPE html>
+<html><head><title>weights</title></head><body style="font-family:sans-serif">
+<h2>Weights view</h2>
+<p>score chart + per-parameter histograms + mean-magnitude time series
+(HistogramIterationListener view)</p>
+<canvas id="score" width="900" height="220" style="border:1px solid #ccc"></canvas>
+<h3>Mean magnitudes</h3>
+<canvas id="mags" width="900" height="220" style="border:1px solid #ccc"></canvas>
+<div id="legend" style="font-size:11px"></div>
+<h3>Parameter histograms (latest iteration)</h3>
+<div id="hists"></div>
+<script>
+const COLORS = ['#0074D9','#FF4136','#2ECC40','#FF851B','#B10DC9','#39CCCC',
+                '#85144b','#3D9970','#111111','#AAAAAA'];
+function line(ctx, xs, W, H, color, mn, mx) {
+  if (!xs.length) return;
+  if (mx === undefined) { mx = Math.max(...xs); mn = Math.min(...xs); }
+  ctx.beginPath();
+  xs.forEach((v,i) => {
+    const x = 20 + i*(W-40)/Math.max(xs.length-1,1);
+    const y = H-20 - (H-40)*(v-mn)/Math.max(mx-mn,1e-9);
+    i ? ctx.lineTo(x,y) : ctx.moveTo(x,y);
+  });
+  ctx.strokeStyle = color; ctx.stroke();
+}
+async function refresh() {
+  const sid = new URLSearchParams(location.search).get('sid') || 'default';
+  // slim series for the charts; full histograms only for the LATEST entry
+  const data = await (await fetch('/weights/series?sid=' + sid)).json();
+  if (!data.length) return;
+  const sc = document.getElementById('score').getContext('2d');
+  sc.clearRect(0,0,900,220);
+  line(sc, data.map(d=>d.score), 900, 220, '#0074D9');
+  sc.fillText('score: ' + data[data.length-1].score.toFixed(5), 25, 12);
+  const mg = document.getElementById('mags').getContext('2d');
+  mg.clearRect(0,0,900,220);
+  const names = Object.keys(data[data.length-1].mean_magnitudes || {});
+  // ONE shared scale so series are comparable (vanishing vs exploding)
+  const series = names.map(n => data.map(d=>(d.mean_magnitudes||{})[n]||0));
+  const gmx = Math.max(...series.flat(), 1e-9);
+  const gmn = Math.min(...series.flat());
+  names.forEach((n,i) =>
+    line(mg, series[i], 900, 220, COLORS[i % COLORS.length], gmn, gmx));
+  mg.fillText('scale: ' + gmn.toPrecision(3) + ' .. ' + gmx.toPrecision(3),
+              25, 12);
+  document.getElementById('legend').innerHTML = names.map((n,i) =>
+    '<span style="color:' + COLORS[i%COLORS.length] + '">&#9632; ' + n +
+    '</span>').join(' ');
+  const hs = document.getElementById('hists');
+  hs.innerHTML = '';
+  const latest = await (await fetch('/weights/latest?sid=' + sid)).json();
+  const params = (latest || {}).parameters || {};
+  for (const [name, h] of Object.entries(params)) {
+    const div = document.createElement('div');
+    div.style.cssText = 'display:inline-block;margin:4px';
+    div.innerHTML = '<div style="font-size:11px">' + name + '</div>' +
+      '<canvas width="220" height="120" style="border:1px solid #eee"></canvas>';
+    hs.appendChild(div);
+    const c = div.querySelector('canvas').getContext('2d');
+    const mx = Math.max(...h.counts, 1);
+    h.counts.forEach((v,i) => {
+      const bw = 200/h.counts.length;
+      c.fillStyle = '#0074D9';
+      c.fillRect(10 + i*bw, 110 - 100*v/mx, bw-1, 100*v/mx);
+    });
+  }
+}
+setInterval(refresh, 3000); refresh();
+</script></body></html>"""
+
+_ACTIVATIONS_PAGE = """<!DOCTYPE html>
+<html><head><title>activations</title></head>
+<body style="font-family:sans-serif">
+<h2>Convolutional activations</h2>
+<p>first-example channel heatmaps per conv layer
+(ConvolutionalIterationListener view)</p>
+<div id="layers"></div>
+<script>
+async function refresh() {
+  const sid = new URLSearchParams(location.search).get('sid') || 'default';
+  const d = await (await fetch('/activations/data?sid=' + sid)).json();
+  if (!d || !d.layers) return;
+  const root = document.getElementById('layers');
+  root.innerHTML = '<p>iteration ' + d.iteration + ', score ' +
+                   (d.score||0).toFixed(5) + '</p>';
+  d.layers.forEach(L => {
+    const h = document.createElement('h3');
+    h.innerText = 'layer ' + L.layer + ' (' + L.h + 'x' + L.w + ')';
+    root.appendChild(h);
+    L.channels.forEach(grid => {
+      const cv = document.createElement('canvas');
+      const scale = Math.max(1, Math.floor(64 / L.h));
+      cv.width = L.w*scale; cv.height = L.h*scale;
+      cv.style.cssText = 'margin:2px;border:1px solid #ddd';
+      root.appendChild(cv);
+      const ctx = cv.getContext('2d');
+      grid.forEach((row,y) => row.forEach((v,x) => {
+        const g = Math.round(255*v);
+        ctx.fillStyle = 'rgb(' + g + ',' + g + ',' + g + ')';
+        ctx.fillRect(x*scale, y*scale, scale, scale);
+      }));
+    });
+  });
+}
+setInterval(refresh, 5000); refresh();
+</script></body></html>"""
+
+_FLOW_PAGE = """<!DOCTYPE html>
+<html><head><title>flow</title></head><body style="font-family:sans-serif">
+<h2>Model flow</h2>
+<p>layer graph (FlowIterationListener view)</p>
+<canvas id="c" width="960" height="640" style="border:1px solid #ccc"></canvas>
+<script>
+async function draw() {
+  const sid = new URLSearchParams(location.search).get('sid') || 'default';
+  const m = await (await fetch('/flow/data?sid=' + sid)).json();
+  if (!m || !m.layers) return;
+  const ctx = document.getElementById('c').getContext('2d');
+  ctx.clearRect(0,0,960,640); ctx.font = '11px sans-serif';
+  const pos = {input: [480, 30]};
+  const W = 150, H = 34;
+  m.layers.forEach((L,i) => {
+    // simple layered placement: depth = longest input chain
+    let depth = 1 + Math.max(0, ...L.inputs.map(s =>
+        pos[s] ? Math.round((pos[s][1]-30)/60) : 0));
+    const row = m.layers.filter((o,j) => j < i &&
+        Math.round((pos[o.name][1]-30)/60) === depth).length;
+    pos[L.name] = [120 + row*320 + (depth%2)*40, 30 + depth*60];
+  });
+  ctx.fillStyle = '#eee';
+  ctx.fillRect(pos.input[0]-W/2, pos.input[1]-H/2, W, H);
+  ctx.strokeRect(pos.input[0]-W/2, pos.input[1]-H/2, W, H);
+  ctx.fillStyle = '#111'; ctx.fillText('input', pos.input[0]-14, pos.input[1]+3);
+  m.layers.forEach(L => {
+    const [x,y] = pos[L.name];
+    L.inputs.forEach(src => {
+      const p = pos[src]; if (!p) return;
+      ctx.beginPath(); ctx.moveTo(p[0], p[1]+H/2);
+      ctx.lineTo(x, y-H/2); ctx.strokeStyle = '#888'; ctx.stroke();
+    });
+    ctx.fillStyle = '#d0e4ff';
+    ctx.fillRect(x-W/2, y-H/2, W, H);
+    ctx.strokeStyle = '#555'; ctx.strokeRect(x-W/2, y-H/2, W, H);
+    ctx.fillStyle = '#111';
+    ctx.fillText(L.name + ': ' + L.type, x-W/2+6, y-3);
+    if (L.n_params !== undefined)
+      ctx.fillText(L.n_params + ' params', x-W/2+6, y+11);
+  });
+}
+draw(); setInterval(draw, 5000);
+</script></body></html>"""
 
 _TSNE_PAGE = """<!DOCTYPE html>
 <html><head><title>t-SNE</title></head><body style="font-family:sans-serif">
@@ -115,6 +268,7 @@ class UiServer:
         self.history = HistoryStorage()
         self.flow = SessionStorage()
         self.tsne = SessionStorage()
+        self.activations = SessionStorage()
         self._nn_trees = {}
         server = self
 
@@ -146,10 +300,28 @@ class UiServer:
                     return self._html(_DASHBOARD)
                 if url.path == "/sessions":
                     return self._json(server.history.sessions())
+                if url.path == "/weights":
+                    return self._html(_WEIGHTS_PAGE)
                 if url.path == "/weights/data":
                     return self._json(server.history.get(sid))
+                if url.path == "/weights/series":
+                    # chart-sized slice of the history: score + magnitudes
+                    # only (the full per-iteration histograms are multi-MB
+                    # on long runs and the page reads just the latest)
+                    return self._json([
+                        {"iteration": d.get("iteration"),
+                         "score": d.get("score"),
+                         "mean_magnitudes": d.get("mean_magnitudes", {})}
+                        for d in server.history.get(sid)])
                 if url.path == "/weights/latest":
                     return self._json(server.history.latest(sid))
+                if url.path == "/activations":
+                    return self._html(_ACTIVATIONS_PAGE)
+                if url.path == "/activations/data":
+                    return self._json(server.activations.get(sid, "latest")
+                                      or {})
+                if url.path == "/flow":
+                    return self._html(_FLOW_PAGE)
                 if url.path == "/flow/data":
                     return self._json(server.flow.get(sid, "model"))
                 if url.path == "/tsne":
@@ -180,6 +352,9 @@ class UiServer:
                     return self._json({"status": "ok"})
                 if url.path == "/flow/update":
                     server.flow.put(sid, "model", payload)
+                    return self._json({"status": "ok"})
+                if url.path == "/activations/update":
+                    server.activations.put(sid, "latest", payload)
                     return self._json({"status": "ok"})
                 if url.path == "/tsne/update":
                     server.tsne.put(sid, "coords",
