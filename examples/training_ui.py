@@ -1,0 +1,77 @@
+"""Training-visualization UI — live weights/activations/flow views.
+
+Run: python examples/training_ui.py [--iterations N] [--port P]
+then open the printed URL: the dashboard links to the /weights view
+(score chart + mean-magnitude series + parameter histograms), the
+/activations view (conv-channel heatmaps), and the /flow view (model
+graph). Mirrors the reference's HistogramIterationListener +
+ConvolutionalIterationListener + FlowIterationListener workflow
+(ui/weights/HistogramIterationListener.java:33).
+"""
+import argparse
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration, Sgd
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.ui.listeners import (ConvolutionalIterationListener,
+                                             FlowIterationListener,
+                                             HistogramIterationListener)
+from deeplearning4j_tpu.ui.server import UiServer
+
+
+def main(iterations: int = 40, port: int = 0, keep_serving: bool = False):
+    server = UiServer(port=port)
+    print(f"UI at {server.url()}  (views: /weights /activations /flow)")
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(7).learning_rate(0.05).updater(Sgd())
+         .list()
+         .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3), padding=(1, 1),
+                                 activation="relu"))
+         .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                 stride=(2, 2)))
+         .layer(DenseLayer(n_out=32, activation="relu"))
+         .layer(OutputLayer(n_out=3, activation="softmax",
+                            loss="negativeloglikelihood"))
+         .set_input_type(InputType.convolutional(12, 12, 1))
+         .build())).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 12, 12, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    listeners = [HistogramIterationListener(server.url(), "example"),
+                 FlowIterationListener(server.url(), "example"),
+                 ConvolutionalIterationListener(server.url(), x[:1],
+                                                "example", frequency=10)]
+    for it in range(iterations):
+        net.fit_batch(x, y)
+        for listener in listeners:
+            listener.iteration_done(net, it)
+    with urllib.request.urlopen(
+            f"{server.url()}/weights/data?sid=example") as resp:
+        n_points = len(json.loads(resp.read()))
+    print(f"posted {n_points} iterations of weights data; final score "
+          f"{net.score_:.4f}")
+    if keep_serving:
+        import time
+        print("serving until Ctrl-C ...")
+        try:
+            time.sleep(86400)
+        except KeyboardInterrupt:
+            pass
+    server.stop()
+    return n_points
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--iterations", type=int, default=40)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--serve", action="store_true",
+                   help="keep the server up after training")
+    a = p.parse_args()
+    main(a.iterations, a.port, a.serve)

@@ -80,19 +80,23 @@ from deeplearning4j_tpu.nlp.diskindex import DiskInvertedIndex
 
 N = 1_000_000
 V = 30_000
+BLOCK = 100_000
 rng = np.random.default_rng(0)
 zipf = 1.0 / np.arange(1, V + 1) ** 0.9
 zipf /= zipf.sum()
 idx = DiskInvertedIndex(sys.argv[1], flush_every=2_000_000)
 t0 = time.time()
-# draw in blocks to keep generation cheap; docs of 4-12 tokens
-lens = rng.integers(4, 13, N)
-flat = rng.choice(V, size=int(lens.sum()), p=zipf)
-pos = 0
 vocab = np.array([f"w{i}" for i in range(V)])
-for n in lens:
-    idx.add_document(vocab[flat[pos:pos + n]].tolist())
-    pos += n
+done = 0
+while done < N:  # generate per block: bounds the generator's own RSS too
+    nblk = min(BLOCK, N - done)
+    lens = rng.integers(4, 13, nblk)
+    flat = rng.choice(V, size=int(lens.sum()), p=zipf)
+    pos = 0
+    for n in lens:
+        idx.add_document(vocab[flat[pos:pos + n]].tolist())
+        pos += n
+    done += nblk
 idx.commit()
 build_s = time.time() - t0
 assert idx.num_documents() == N
@@ -118,11 +122,19 @@ def test_million_documents_bounded_memory(tmp_path):
     driver = tmp_path / "driver.py"
     driver.write_text(_MILLION_DOC_DRIVER)
     repo = str(Path(__file__).resolve().parent.parent)
-    out = subprocess.run(
-        [sys.executable, str(driver), str(tmp_path / "bigix"), repo],
-        capture_output=True, text=True, timeout=900)
-    assert out.returncode == 0, out.stderr[-2000:]
-    assert "OK" in out.stdout
+    out = None
+    for attempt in (1, 2):  # retry ONLY signal deaths (negative rc, e.g.
+        # OOM-kill under concurrent host memory pressure — environmental);
+        # a real index regression exits positive and fails immediately
+        out = subprocess.run(
+            [sys.executable, str(driver), str(tmp_path / "bigix"), repo],
+            capture_output=True, text=True, timeout=900)
+        if out.returncode >= 0:
+            break
+        import shutil
+        shutil.rmtree(tmp_path / "bigix", ignore_errors=True)
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-2000:])
+    assert "OK" in out.stdout, out.stdout[-500:]
     rss_mb = float(out.stdout.split("rss_mb=")[1].split()[0])
     assert rss_mb < 800, f"peak RSS {rss_mb} MB — memory not bounded"
     # the committed index is on disk and reopenable

@@ -50,3 +50,9 @@ def test_transformer_example():
     import transformer_lm
     acc = transformer_lm.main(steps=60, vocab=11, seq_len=12, batch=16)
     assert acc > 0.8
+
+
+def test_training_ui_example():
+    import training_ui
+    n = training_ui.main(iterations=5)
+    assert n == 5
