@@ -234,12 +234,18 @@ def main() -> None:
     sents = [" ".join(f"w{t}" for t in tokens[i:i + 40])
              for i in range(0, n_tokens, 40)]
     rates = []
-    for _i in range(5):
+    for _i in range(6):
         w2v = (Word2Vec.builder().layer_size(100).window_size(5)
                .negative_sample(5).min_word_frequency(1).epochs(1)
                .batch_size(8192).seed(1).iterate(sents).build())
         w2v.fit()
         rates.append(w2v.words_per_sec_)
+    # fit 1 is an UNTIMED-in-spirit warm-up (page cache, producer thread,
+    # CPU governor): measured 6x below steady state on an otherwise idle
+    # host; statistics are over the 5 post-warm-up fits, and the discarded
+    # warm-up value is RECORDED so the selection is auditable from the
+    # artifact alone
+    warmup_rate, rates = rates[0], rates[1:]
     med = float(np.median(rates))
     WORKLOADS["word2vec_skipgram"] = {
         # the HEADLINE is the median (VERDICT r4 weak #4: a max over a
@@ -249,10 +255,13 @@ def main() -> None:
         "words_per_sec_max": round(max(rates), 1),
         "max_over_median": round(max(rates) / med, 2),
         "runs": [round(r, 1) for r in rates],
+        "discarded_warmup_fit": round(warmup_rate, 1),
         "note": "synthetic zipf corpus (no egress for text8); host pair-gen "
-                "overlapped with device steps (double-buffered); median of 5 "
-                "fits on an idle host (first workload in the bench); "
-                "steady-state (compile excluded by fit's warmup)",
+                "overlapped with device steps (double-buffered); 6 fits ran "
+                "on an idle host (first workload in the bench), the COLD "
+                "FIRST fit is discarded as warm-up (its value is recorded "
+                "in discarded_warmup_fit), statistics are the median/max of "
+                "the remaining 5",
     }
 
     # ---- 1. LeNet-MNIST (headline; Nesterovs, SGD-class) --------------------
@@ -281,11 +290,14 @@ def main() -> None:
     _bench_net("alexnet_cifar10", alexnet_cifar10(dtype=dtype), x, y,
                B, 2, 2048, dtype, scan_k=32)
     if on_tpu:
-        # accelerated-helper seam engaged on the CNN path: the fused
-        # BN+act+pool composite autotunes per shape against XLA (silent
-        # fallback — at these shapes XLA usually wins; docs/ROOFLINE_CNN.md
-        # has the full study). Decisions are recorded either way.
-        pallas_kernels.enable(interpret=False)
+        # standing full-model A/B for the PRODUCTION-RETIRED bn_act_pool
+        # kernel (r5): enable() no longer registers it on TPU — three
+        # full-model A/Bs measured delta 1.024/0.975/0.976, parity within
+        # tunnel noise, below the >=1.05 bar (win-or-delete, same rule
+        # that retired the LSTM kernel; full history in the enable()
+        # docstring + docs/ROOFLINE_CNN.md). This row keeps producing the
+        # retirement's ground-truth evidence each round.
+        pallas_kernels.enable(interpret=False, use_bn_act_pool=True)
         pallas_kernels.clear_autotune_cache()
         try:
             _bench_net("alexnet_cifar10_pallas", alexnet_cifar10(dtype=dtype),
@@ -301,6 +313,10 @@ def main() -> None:
             entry["helper_delta_vs_xla"] = (
                 round(entry["examples_per_sec"] / base, 3)
                 if any(dec.values()) else 1.0)
+            entry["status"] = (
+                "bn_act_pool kernel PRODUCTION-RETIRED r5 (win-or-delete): "
+                "this row is the standing full-model A/B that justifies it; "
+                "default enable() compiles the pure-XLA program")
         finally:
             pallas_kernels.disable()
 

@@ -713,7 +713,7 @@ def attention_pallas(q, k, v, *, causal=False, scale=None):
 # registration
 # =============================================================================
 
-def enable(interpret=None, use_conv=None) -> None:
+def enable(interpret=None, use_conv=None, use_bn_act_pool=None) -> None:
     """Register the Pallas kernels behind the helper seam.
 
     interpret=None auto-detects: compiled on TPU, interpreter elsewhere
@@ -722,18 +722,36 @@ def enable(interpret=None, use_conv=None) -> None:
 
     use_conv=None registers the conv kernel only in interpreter (test) runs:
     on real TPU it measures slower than XLA's native conv (see
-    conv2d_bias_act_pallas), while the LSTM kernel wins in its regime and is
-    always registered.
+    conv2d_bias_act_pallas).
+
+    use_bn_act_pool=None likewise registers the fused BN+act+pool backward
+    only in interpreter (test) runs — PRODUCTION-RETIRED r5 by the same
+    win-or-delete rule that retired the LSTM kernel. Measured history on
+    the AlexNet-CIFAR10 flagship (v5e, bf16, B=512): the r4 ISOLATED
+    scan-probe win (1.10-1.13x at C>=128) was already known not to
+    survive in context (full-model 0.995, VERDICT r4 weak #3); the r5
+    IN-CONTEXT probe (composite sandwiched in a producer conv, >=5%
+    required margin) still selected it, but three independent full-model
+    A/Bs measured helper_delta_vs_xla = 1.024 / 0.975 / 0.976 — parity
+    within tunnel noise, median slightly NEGATIVE, below the >=1.05
+    full-model bar (VERDICT r4 item 5). The custom-call boundary forfeits
+    XLA's fusion of BN-dx into the adjacent conv gradients and the 2-pass
+    HBM saving does not cover that loss at these shapes. Kernel, VJP,
+    autotuner, and interpret-mode numerics tests remain for
+    experimentation (pass use_bn_act_pool=True).
     """
     global _INTERPRET
     _INTERPRET = (jax.default_backend() != "tpu") if interpret is None \
         else bool(interpret)
     if use_conv is None:
         use_conv = _INTERPRET
+    if use_bn_act_pool is None:
+        use_bn_act_pool = _INTERPRET
     if use_conv:
         helpers.register_helper("conv2d_bias_act", conv2d_bias_act_pallas)
     helpers.register_helper("attention", attention_pallas)
-    helpers.register_helper("bn_act_pool", bn_act_pool_pallas)
+    if use_bn_act_pool:
+        helpers.register_helper("bn_act_pool", bn_act_pool_pallas)
 
 
 def disable() -> None:
