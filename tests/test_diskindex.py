@@ -84,6 +84,26 @@ BLOCK = 100_000
 rng = np.random.default_rng(0)
 zipf = 1.0 / np.arange(1, V + 1) ** 0.9
 zipf /= zipf.sum()
+def rss():
+    # current VmRSS, NOT ru_maxrss: the hiwater counter is poisoned by
+    # fork inheritance — a child forked from a fat parent (pytest after
+    # jax tests, ~1 GB) starts with the parent's COW-resident set as its
+    # "peak" before exec, so ru_maxrss reports the PARENT's size no
+    # matter what this process actually uses. VmRSS sampled at the
+    # high-water stages measures this process alone.
+    with open("/proc/self/status") as fh:
+        for line in fh:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return float("nan")
+
+peak = 0.0
+def sample():
+    global peak
+    peak = max(peak, rss())
+
+sample()
+print(f"rss_after_imports={rss():.0f}", flush=True)
 idx = DiskInvertedIndex(sys.argv[1], flush_every=2_000_000)
 t0 = time.time()
 vocab = np.array([f"w{i}" for i in range(V)])
@@ -97,7 +117,11 @@ while done < N:  # generate per block: bounds the generator's own RSS too
         idx.add_document(vocab[flat[pos:pos + n]].tolist())
         pos += n
     done += nblk
+    sample()  # per block: catches the pre-spill postings-buffer high water
+print(f"rss_after_add={rss():.0f}", flush=True)
 idx.commit()
+sample()
+print(f"rss_after_commit={rss():.0f}", flush=True)
 build_s = time.time() - t0
 assert idx.num_documents() == N
 # search + TF-IDF over the committed corpus
@@ -109,8 +133,8 @@ df = idx.doc_frequency("w0")
 assert 0 < df <= N
 doc = idx.document(d0)
 assert 4 <= len(doc) <= 12
-rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
-print(f"OK build_s={build_s:.1f} rss_mb={rss_mb:.0f} df_w0={df}", flush=True)
+sample()
+print(f"OK build_s={build_s:.1f} rss_mb={peak:.0f} df_w0={df}", flush=True)
 """
 
 
@@ -136,7 +160,8 @@ def test_million_documents_bounded_memory(tmp_path):
     assert out.returncode == 0, (out.stdout[-500:], out.stderr[-2000:])
     assert "OK" in out.stdout, out.stdout[-500:]
     rss_mb = float(out.stdout.split("rss_mb=")[1].split()[0])
-    assert rss_mb < 800, f"peak RSS {rss_mb} MB — memory not bounded"
+    assert rss_mb < 800, (f"peak RSS {rss_mb} MB — memory not bounded; "
+                          f"stages: {out.stdout[:300]}")
     # the committed index is on disk and reopenable
     idx = DiskInvertedIndex.open(str(tmp_path / "bigix"))
     assert idx.num_documents() == 1_000_000
