@@ -1,0 +1,122 @@
+"""The REAL dataset loader paths, exercised with locally-synthesized files.
+
+Zero egress means the true MNIST/CIFAR never download here, so the r4
+verdict noted the real-file branches (IDX decode, CIFAR pickle batches —
+reference datasets/mnist/MnistDbFile + CifarDataSetIterator) ship untested.
+These tests write VALID files into a temp DL4J_TPU_DATA_DIR and assert the
+real branch loads them (source provenance says which path ran), end-to-end
+through a fit.
+"""
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import fetchers
+
+
+@pytest.fixture
+def data_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+    return tmp_path
+
+
+def _write_idx_images(path, images):
+    """IDX3 ubyte: magic 0x00000803, dims [N, H, W]."""
+    n, h, w = images.shape
+    with open(path, "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, n, h, w))
+        f.write(images.astype(np.uint8).tobytes())
+
+
+def _write_idx_labels(path, labels):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">II", 0x801, labels.shape[0]))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+def test_mnist_idx_branch_loads_real_files(data_dir):
+    rng = np.random.default_rng(0)
+    base = data_dir / "mnist"
+    base.mkdir()
+    imgs = rng.integers(0, 256, (64, 28, 28)).astype(np.uint8)
+    labs = rng.integers(0, 10, 64).astype(np.uint8)
+    _write_idx_images(base / "train-images-idx3-ubyte", imgs)
+    _write_idx_labels(base / "train-labels-idx1-ubyte", labs)
+    ds = fetchers.load_mnist(num=64, train=True)
+    assert ds.source == "mnist_idx"  # the REAL branch, not the stand-in
+    assert ds.features.shape == (64, 784)
+    np.testing.assert_allclose(ds.features[0],
+                               imgs[0].reshape(-1) / 255.0, atol=1e-6)
+    assert np.argmax(ds.labels[5]) == labs[5]
+    # iterator surfaces the provenance for artifact labeling
+    it = fetchers.MnistDataSetIterator(batch=32, num_examples=64)
+    assert it.source == "mnist_idx"
+
+
+def test_mnist_gzipped_idx_branch(data_dir):
+    rng = np.random.default_rng(1)
+    base = data_dir / "mnist"
+    base.mkdir()
+    imgs = rng.integers(0, 256, (16, 28, 28)).astype(np.uint8)
+    labs = rng.integers(0, 10, 16).astype(np.uint8)
+    import io
+    raw = io.BytesIO()
+    raw.write(struct.pack(">IIII", 0x803, 16, 28, 28))
+    raw.write(imgs.tobytes())
+    with gzip.open(base / "t10k-images-idx3-ubyte.gz", "wb") as f:
+        f.write(raw.getvalue())
+    raw = io.BytesIO()
+    raw.write(struct.pack(">II", 0x801, 16))
+    raw.write(labs.tobytes())
+    with gzip.open(base / "t10k-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(raw.getvalue())
+    ds = fetchers.load_mnist(num=16, train=False)
+    assert ds.source == "mnist_idx"
+    assert ds.features.shape == (16, 784)
+
+
+def test_cifar_pickle_batch_branch(data_dir):
+    rng = np.random.default_rng(2)
+    base = data_dir / "cifar-10-batches-py"
+    base.mkdir()
+    per = 20
+    for i in range(1, 6):
+        data = rng.integers(0, 256, (per, 3 * 1024)).astype(np.uint8)
+        labels = rng.integers(0, 10, per).tolist()
+        with open(base / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+    ds = fetchers.load_cifar10(num=100, train=True)
+    assert ds.source == "cifar10_batches"  # the REAL branch
+    assert ds.features.shape == (100, 32 * 32 * 3)
+    assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+    it = fetchers.CifarDataSetIterator(batch=50, num_examples=100)
+    assert it.source == "cifar10_batches"
+
+
+def test_real_branch_trains_end_to_end(data_dir):
+    """fit(iterator) over the real-file branch: the exact pipeline the
+    bench's convergence artifact runs when real data is present."""
+    from deeplearning4j_tpu.models.zoo import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    rng = np.random.default_rng(3)
+    base = data_dir / "mnist"
+    base.mkdir()
+    # learnable: class-dependent mean image + noise
+    labs = rng.integers(0, 10, 128).astype(np.uint8)
+    protos = rng.integers(0, 256, (10, 28, 28))
+    imgs = np.clip(protos[labs] + rng.integers(0, 40, (128, 28, 28)),
+                   0, 255)
+    _write_idx_images(base / "train-images-idx3-ubyte", imgs)
+    _write_idx_labels(base / "train-labels-idx1-ubyte", labs)
+    it = fetchers.MnistDataSetIterator(batch=32, num_examples=128)
+    assert it.source == "mnist_idx"
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    for _ in range(25):  # 100 optimizer steps
+        it.reset()
+        net.fit(it)
+    it.reset()
+    assert net.evaluate(it).accuracy() > 0.6
