@@ -1,0 +1,51 @@
+"""Device trace of the LeNet-MNIST train step (the headline workload):
+where does a 0.32 ms step at MFU ~0.11 actually go? Prints the xplane
+per-op summary via tools/xplane_summary. Run from /root/repo:
+`python tools/trace_lenet.py`.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(0)
+    B = 512
+    x = jnp.asarray(rng.normal(size=(B, 28, 28, 1)), jnp.bfloat16)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, B)])
+    net = MultiLayerNetwork(lenet_mnist(dtype="bfloat16")).init()
+    scan_k = 64
+    xs = jnp.tile(x[None], (scan_k,) + (1,) * x.ndim)
+    ys = jnp.tile(y[None], (scan_k,) + (1,) * y.ndim)
+    _ = float(net.fit_scan(xs, ys)[-1])  # compile + warm
+
+    logdir = "/tmp/lenet_trace"
+    os.system(f"rm -rf {logdir}")
+    with jax.profiler.trace(logdir):
+        for _ in range(4):
+            losses = net.fit_scan(xs, ys)
+        _ = float(losses[-1])
+
+    xplanes = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    if not xplanes:
+        print("NO XPLANE CAPTURED")
+        return
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import xplane_summary
+    xplane_summary.summarize(logdir, 25)
+
+
+if __name__ == "__main__":
+    main()
