@@ -56,3 +56,9 @@ def test_training_ui_example():
     import training_ui
     n = training_ui.main(iterations=5)
     assert n == 5
+
+
+def test_seq2seq_addition_example():
+    import seq2seq_addition
+    acc = seq2seq_addition.main(steps=200, batch=64, hidden=48)
+    assert acc > 0.3  # digit accuracy; chance is 1/12
