@@ -615,6 +615,50 @@ def main() -> None:
     except Exception as e:
         WORKLOADS["alexnet_cifar10"]["synthetic_cifar_accuracy"] = f"error: {e}"
 
+    # ---- 9. int8 post-training-quantized inference A/B (beyond reference;
+    # nn/quantization.py). Reuses the convergence-trained AlexNet: BN folded
+    # into convs, per-channel int8 weights, calibrated activation scales —
+    # the MXU's s8 path at 2x bf16 peak. No floor: the row is evidence for
+    # the capability, win or lose, like the kernel A/B rows. --------------
+    try:
+        from deeplearning4j_tpu.nn.quantization import quantize
+        cit.reset()
+        calib = next(iter(cit))
+        qnet = quantize(cnet, [calib])
+        xb = jnp.asarray(calib.features)
+        B = int(xb.shape[0])
+
+        def _infer_time(fn, iters=50, blocks=3):
+            fn(xb).block_until_ready()  # compile + warm
+            best = float("inf")
+            for _ in range(blocks):
+                t0 = time.perf_counter()
+                for _i in range(iters):
+                    out = fn(xb)
+                out.block_until_ready()
+                best = min(best, (time.perf_counter() - t0) / iters)
+            return best
+
+        t_f = _infer_time(lambda a: cnet.output(a))
+        t_q = _infer_time(lambda a: qnet.output(a))
+        cit.reset()
+        qacc = qnet.evaluate(cit).accuracy()
+        facc = WORKLOADS["alexnet_cifar10"].get(ckey)
+        WORKLOADS["alexnet_cifar10_int8"] = {
+            "examples_per_sec_float": round(B / t_f),
+            "examples_per_sec_int8": round(B / t_q),
+            "int8_speedup": round(t_f / t_q, 3),
+            "int8_accuracy": round(qacc, 4),
+            "accuracy_delta_vs_float": (round(qacc - facc, 4)
+                                        if isinstance(facc, float) else None),
+            "param_bytes_ratio": round(qnet.param_bytes() /
+                                       qnet.float_param_bytes(), 3),
+            "note": f"B={B} batch inference, BN-folded per-channel int8 "
+                    "weights, calibrated per-tensor activation scales",
+        }
+    except Exception as e:
+        WORKLOADS["alexnet_cifar10_int8"] = {"error": str(e)}
+
     # ---- perf-regression gate vs committed floors (BENCH_FLOORS.json) ----
     regressions = check_floors(WORKLOADS)
 

@@ -1,0 +1,243 @@
+"""Post-training int8 quantization (nn/quantization.py — beyond reference).
+
+The reference has no quantization; these tests pin the new capability's
+correctness contract: BN folding is float-exact, int8 inference tracks the
+float net closely, unquantizable nets degrade gracefully to float, and the
+int8 weights actually are int8 (the 4x size claim).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.quantization import (QuantizedNetwork,
+                                                _bn_scale_shift,
+                                                _build_steps, fold_batchnorm,
+                                                quantize)
+from deeplearning4j_tpu.nn.updater.updaters import Sgd
+
+
+def _mlp_net(seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(0.1).updater(Sgd())
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=32, activation="relu"))
+            .layer(DenseLayer(n_in=32, n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_in=32, n_out=4, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _conv_bn_net(seed=3):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(0.05).updater(Sgd())
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3), stride=(1, 1),
+                                    padding=(1, 1), activation="identity"))
+            .layer(BatchNormalization(activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=24, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(8, 8, 2))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _clsdata(rng, n, shape, k):
+    """Class-structured data: per-class mean offsets, learnable quickly."""
+    y = rng.integers(0, k, n)
+    x = rng.standard_normal((n,) + shape).astype(np.float32) * 0.5
+    x += y.reshape((-1,) + (1,) * len(shape)).astype(np.float32)
+    return x, np.eye(k, dtype=np.float32)[y]
+
+
+def test_fold_batchnorm_is_float_exact():
+    """BN(conv(x)) == conv'(x) with folded weights, to float precision."""
+    rng = np.random.default_rng(0)
+    net = _conv_bn_net()
+    x, y = _clsdata(rng, 32, (8, 8, 2), 3)
+    for _ in range(4):  # move BN stats/params off init
+        net._fit_one(jnp.asarray(x), jnp.asarray(y), None, None)
+
+    conv_p = net.params[0]
+    scale, shift = _bn_scale_shift(net._impls[1], net.params[1],
+                                   net.variables[1])
+    Wf, bf = fold_batchnorm(conv_p["W"], conv_p["b"], scale, shift)
+
+    xb = jnp.asarray(x[:8])
+    raw = lax.conv_general_dilated(
+        xb, jnp.asarray(conv_p["W"]), window_strides=(1, 1),
+        padding=((1, 1), (1, 1)), rhs_dilation=(1, 1),
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + jnp.asarray(conv_p["b"])
+    want = jnp.asarray(scale, jnp.float32) * raw + jnp.asarray(shift, jnp.float32)
+    got = lax.conv_general_dilated(
+        xb, jnp.asarray(Wf, jnp.float32), window_strides=(1, 1),
+        padding=((1, 1), (1, 1)), rhs_dilation=(1, 1),
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + jnp.asarray(bf, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_build_steps_folds_conv_bn_pair():
+    net = _conv_bn_net()
+    steps = _build_steps(net, fold_bn=True)
+    kinds = [s.kind for s in steps]
+    assert kinds == ["conv", "float", "dense", "dense"]
+    assert steps[0].consumed == 2  # conv+BN merged
+    steps_nofold = _build_steps(net, fold_bn=False)
+    assert [s.kind for s in steps_nofold] == \
+        ["conv", "float", "float", "dense", "dense"]
+
+
+def test_dense_bn_pair_folds_too():
+    """Dense(identity)->BN folds exactly like conv->BN (PARITY claims
+    'convs/denses'); the quantized net tracks the float net."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(11).learning_rate(0.1).updater(Sgd())
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="identity"))
+            .layer(BatchNormalization(n_in=16, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(4)
+    x, y = _clsdata(rng, 128, (8,), 4)
+    for _ in range(10):
+        net._fit_one(jnp.asarray(x), jnp.asarray(y), None, None)
+    steps = _build_steps(net, fold_bn=True)
+    assert [s.kind for s in steps] == ["dense", "dense"]
+    assert steps[0].consumed == 2
+    qnet = quantize(net, [x[:32]])
+    ref = np.asarray(net.output(x))
+    got = np.asarray(qnet.output(x))
+    assert np.max(np.abs(got - ref)) < 0.08
+
+
+def test_no_fold_across_preprocessor_at_bn_index():
+    """A preprocessor registered AT the BN's index runs between the pair —
+    folding across it would silently skip it, so the fold must not engage
+    (review finding)."""
+    from deeplearning4j_tpu.nn.conf.preprocessors import \
+        FeedForwardToRnnPreProcessor
+    net = _conv_bn_net()
+    net.conf.input_preprocessors["1"] = FeedForwardToRnnPreProcessor()
+    steps = _build_steps(net, fold_bn=True)
+    assert steps[0].kind == "conv" and steps[0].consumed == 1
+    assert steps[1].kind == "float"  # BN stays a float step
+    del net.conf.input_preprocessors["1"]
+
+
+def test_int8_mlp_tracks_float_net():
+    rng = np.random.default_rng(1)
+    net = _mlp_net()
+    x, y = _clsdata(rng, 256, (8,), 4)
+    for _ in range(30):
+        net._fit_one(jnp.asarray(x[:128]), jnp.asarray(y[:128]), None, None)
+
+    calib = [DataSet(x[:64], y[:64])]
+    qnet = quantize(net, calib)
+    # int8 weights, really
+    for si, st in enumerate(qnet._steps):
+        if st.kind == "dense":
+            assert qnet._consts[si][0].dtype == jnp.int8
+
+    xt = x[128:]
+    ref = np.asarray(net.output(xt))
+    got = np.asarray(qnet.output(xt))
+    assert got.shape == ref.shape
+    # softmax outputs: small absolute deviation + argmax agreement
+    assert np.max(np.abs(got - ref)) < 0.08
+    agree = np.mean(np.argmax(got, -1) == np.argmax(ref, -1))
+    assert agree >= 0.97, f"argmax agreement {agree}"
+
+
+def test_int8_conv_bn_net_accuracy_close_to_float():
+    rng = np.random.default_rng(2)
+    net = _conv_bn_net()
+    x, y = _clsdata(rng, 512, (8, 8, 2), 3)
+    for _ in range(25):
+        net._fit_one(jnp.asarray(x[:256]), jnp.asarray(y[:256]), None, None)
+
+    test_it = ListDataSetIterator(DataSet(x[256:], y[256:]), batch=64)
+    facc = net.evaluate(test_it).accuracy()
+    assert facc > 0.7, f"float net failed to learn ({facc}) — test inconclusive"
+
+    qnet = quantize(net, [DataSet(x[:64], y[:64])])
+    test_it.reset()
+    qacc = qnet.evaluate(test_it).accuracy()
+    assert abs(facc - qacc) <= 0.05, f"float {facc} vs int8 {qacc}"
+    # folded conv is quantized: exactly one conv step, int8
+    conv_steps = [s for s in qnet._steps if s.kind == "conv"]
+    assert len(conv_steps) == 1 and conv_steps[0].Wq.dtype == np.int8
+
+
+def test_param_bytes_shrink():
+    net = _mlp_net()
+    qnet = quantize(net, [np.zeros((4, 8), np.float32)])
+    # all three layers are dense -> ~4x weight shrink; per-channel scales +
+    # f32 biases add back a few percent (more visible on this tiny MLP)
+    assert qnet.param_bytes() < 0.35 * qnet.float_param_bytes()
+
+
+def test_unquantizable_net_falls_back_to_float_exactly():
+    """A net with no dense/conv layers degrades to pure float fallback and
+    matches the source net's output."""
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).learning_rate(0.1).updater(Sgd())
+            .list()
+            .layer(GravesLSTM(n_in=6, n_out=12, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=12, n_out=4, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(3).standard_normal((4, 10, 6)).astype(np.float32)
+    qnet = quantize(net, [x])
+    assert all(s.kind == "float" for s in qnet._steps
+               if s.index == 0)  # LSTM not quantized
+    np.testing.assert_allclose(np.asarray(qnet.output(x)),
+                               np.asarray(net.output(x)), rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_net_stays_bf16_through_fallback_layers():
+    """act_dtype contract: a bf16-compute net returns bf16 from the
+    quantized path even when float-fallback layers (non-folded BN, pool)
+    hold f32 params/variables (review finding: f32 creep)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(9).learning_rate(0.05).updater(Sgd())
+            .compute_dtype("bfloat16")
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3), stride=(1, 1),
+                                    padding=(1, 1), activation="relu"))
+            .layer(BatchNormalization(activation="identity"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(8, 8, 2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(6).standard_normal((8, 8, 8, 2)).astype(np.float32)
+    qnet = quantize(net, [x])
+    # conv(relu) can't fold across -> BN is a float-fallback step
+    assert any(s.kind == "float" for s in qnet._steps)
+    assert qnet.output(x).dtype == jnp.bfloat16
+    assert net.output(x).dtype == jnp.bfloat16
+
+
+def test_calibration_required():
+    net = _mlp_net()
+    with pytest.raises(ValueError):
+        quantize(net, [])
