@@ -7,7 +7,8 @@ this package initializes jax (the submodules need it at import time).
 from .mesh import DATA_AXIS, default_mesh, hybrid_mesh, make_mesh
 from .trainer import (IciDataParallelTrainingMaster, ParallelWrapper,
                       ParameterAveragingTrainingMaster, TrainingMaster)
-from .statetracker import TrainingStateTracker, fit_with_recovery
+from .statetracker import (AsyncTrainingStateTracker,
+                           TrainingStateTracker, fit_with_recovery)
 from .registry import ConfigurationRegistry
 from .pipeline import GPipeExecutor, stack_block_params
 from .moe import MoEExecutor
@@ -24,7 +25,7 @@ __all__ = [
     "DATA_AXIS", "default_mesh", "hybrid_mesh", "make_mesh",
     "TrainingMaster", "IciDataParallelTrainingMaster",
     "ParameterAveragingTrainingMaster", "ParallelWrapper",
-    "TrainingStateTracker", "fit_with_recovery", "ConfigurationRegistry",
+    "TrainingStateTracker", "AsyncTrainingStateTracker", "fit_with_recovery", "ConfigurationRegistry",
     "GPipeExecutor", "stack_block_params", "MoEExecutor",
     "SparkDl4jMultiLayer", "SparkComputationGraph", "shard_transformer_tp",
     "distributed_evaluate", "distributed_score",

@@ -188,6 +188,15 @@ class TrainingStateTracker:
     def save(self, net, cursor: Optional[dict] = None) -> Path:
         """Write one atomic checkpoint. `cursor` is arbitrary JSON state the
         training driver needs to resume (epoch, batch index, ...)."""
+        path = self._write(net, cursor)
+        self._since_save = 0
+        return path
+
+    def _write(self, net, cursor: Optional[dict] = None) -> Path:
+        """The serialization itself — does NOT touch the batch counter (the
+        async tracker runs this on its writer thread, where resetting
+        `_since_save` would wipe batch_done counts accumulated during a
+        slow write and stretch the loss bound past every_n_batches)."""
         from ..util.model_serializer import write_model
         seq_prev = [int(p.stem.split("-")[1]) for p in self._checkpoint_paths()]
         seq = (max(seq_prev) + 1) if seq_prev else 0
@@ -204,7 +213,6 @@ class TrainingStateTracker:
         with open(tmp, "rb") as fh:  # durability before the atomic rename
             os.fsync(fh.fileno())
         os.replace(tmp, final)
-        self._since_save = 0
         for old in self._checkpoint_paths()[:-self.keep_last]:
             try:
                 old.unlink()
@@ -217,6 +225,11 @@ class TrainingStateTracker:
         self._since_save += 1
         if self._since_save >= self.every_n_batches:
             return self.save(net, cursor)
+        return None
+
+    def wait(self) -> Optional[Path]:
+        """Synchronous tracker: every save is already durable; no-op.
+        (AsyncTrainingStateTracker overrides this to join its writer.)"""
         return None
 
     # -- restore ---------------------------------------------------------------
@@ -246,6 +259,101 @@ class TrainingStateTracker:
         net._key = jnp.asarray(np.asarray(cursor.pop("rng_key"), np.uint32))
         net.step = int(cursor.get("step", net.step))
         return cursor
+
+
+def _snapshot(net):
+    """Asynchronous point-in-time snapshot of a net's training state.
+
+    Each leaf is snapshotted with a DEVICE-side copy: the copy op is only
+    *enqueued* here (jax dispatch is async), runs at HBM bandwidth, and is
+    ordered before any later donating train step — so the snapshot is
+    consistent as-of-now and `save()` returns without waiting for device
+    work, let alone device->host transfer. A plain reference capture is NOT
+    enough: the jitted train steps donate their input buffers, which
+    deletes the captured arrays on the very next step. (The reference has
+    the same problem for a different reason — its params are one mutable
+    flat INDArray, Model.java:95-108 — and would need a locked host copy.)
+    """
+    import jax
+
+    def leaf(a):
+        return a.copy() if isinstance(a, jax.Array) else a
+
+    snap = object.__new__(type(net))
+    snap.conf = net.conf
+    snap.params = jax.tree_util.tree_map(leaf, net.params)
+    snap.updater_state = jax.tree_util.tree_map(leaf, net.updater_state)
+    snap.variables = jax.tree_util.tree_map(leaf, net.variables)
+    snap.step = int(net.step)
+    snap._key = leaf(net._key)
+    snap._initialized = True
+    return snap
+
+
+class AsyncTrainingStateTracker(TrainingStateTracker):
+    """Async (orbax-style) checkpointing: `save()` enqueues device-side
+    copies of the state (dispatch-only — see `_snapshot`) and returns
+    immediately; one background writer thread does the device->host fetch,
+    zip serialization, fsync and atomic rename. The training loop never
+    stalls on checkpoint IO — on a TPU that means the step pipeline stays
+    full through a save.
+
+    At most one save is in flight (a new `save()` first waits for the
+    previous one, surfacing any writer error there); `wait()` blocks until
+    the pending checkpoint is durable; `restore()`/`close()` imply `wait()`.
+    Kill-safety is inherited: the writer goes through the same
+    write-tmp -> fsync -> os.replace protocol, so dying mid-save leaves the
+    previous checkpoint intact.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        import concurrent.futures
+        self._writer = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer")
+        self._pending = None
+
+    def save(self, net, cursor: Optional[dict] = None):
+        """Snapshot now, write in the background. Returns a Future[Path]."""
+        self.wait()  # bound in-flight saves to 1; surface earlier failures
+        snap = _snapshot(net)
+        cur = dict(cursor or {})
+        self._pending = self._writer.submit(self._write, snap, cur)
+        self._since_save = 0
+        return self._pending
+
+    def wait(self) -> Optional[Path]:
+        """Block until the in-flight checkpoint (if any) is durable."""
+        pending, self._pending = self._pending, None
+        return pending.result() if pending is not None else None
+
+    def restore(self, net) -> Optional[dict]:
+        self.wait()
+        return super().restore(net)
+
+    def close(self) -> None:
+        """Make the in-flight save durable and release the writer thread.
+        The shutdown happens even when the pending write failed (the error
+        still propagates)."""
+        try:
+            self.wait()
+        finally:
+            self._writer.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # the with-body's exception wins; still release the writer and
+            # don't let a failed background save replace it
+            try:
+                self.close()
+            except Exception:
+                pass
+            return False
+        self.close()
+        return False
 
 
 def fit_with_recovery(net, make_iterator: Callable[[int], object],
@@ -280,6 +388,7 @@ def fit_with_recovery(net, make_iterator: Callable[[int], object],
         if master is not None and master_tracker is not None:
             master.state_tracker = master_tracker
     tracker.save(net, {"epoch": epochs, "batch": 0, "done": True})
+    tracker.wait()  # async trackers: the final checkpoint must be durable
     return {"epochs": epochs, "final_step": net.step}
 
 

@@ -239,6 +239,10 @@ class IciDataParallelTrainingMaster(TrainingMaster):
             if self.state_tracker is not None:
                 self.state_tracker.batch_done(
                     net, {"master_batches": self._batches_done})
+        if self.state_tracker is not None:
+            # async trackers: the last checkpoint must be durable (and any
+            # background write error must surface) before fit returns
+            self.state_tracker.wait()
 
     def get_training_stats(self):
         return self.stats
@@ -457,6 +461,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                     flush()
             while buf:
                 flush()
+        if self.state_tracker is not None:
+            # async trackers: final checkpoint durable before fit returns
+            self.state_tracker.wait()
 
     def get_training_stats(self):
         return self.stats

@@ -52,6 +52,11 @@ def test_transformer_example():
     assert acc > 0.8
 
 
+def test_quantized_inference_example():
+    import quantized_inference
+    assert quantized_inference.main(epochs=1, n=96, batch=48) == 4
+
+
 def test_training_ui_example():
     import training_ui
     n = training_ui.main(iterations=5)
