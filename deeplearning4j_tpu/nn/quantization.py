@@ -86,6 +86,27 @@ def _weight_qparams(W: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return Wq, s.astype(np.float32)
 
 
+def _int8_forward(kind, Wq, w_scale, bias, x_scale, conv_args, activation,
+                  act_dtype, x):
+    """THE int8 inference kernel, shared by both facades: per-tensor input
+    quantization, s8xs8->s32 dot/conv, f32 dequant epilogue, activation,
+    cast to the net's activation dtype."""
+    xq = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
+    if kind == "dense":
+        acc = lax.dot_general(xq, Wq, (((x.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    else:
+        acc = lax.conv_general_dilated(
+            xq, Wq,
+            window_strides=conv_args["stride"],
+            padding=conv_args["padding"],
+            rhs_dilation=conv_args["dilation"],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (x_scale * w_scale) + bias
+    return activation(y).astype(act_dtype)
+
+
 class _QStep:
     """One plan step. kind: 'dense' | 'conv' | 'float'."""
 
@@ -149,21 +170,8 @@ class QuantizedNetwork:
     def _run(self, params, variables, x):
         def qstep(si, st, cur):
             Wq, sw, b, sx = self._consts[si]
-            xq = jnp.clip(jnp.round(cur / sx), -127, 127).astype(jnp.int8)
-            if st.kind == "dense":
-                acc = lax.dot_general(
-                    xq, Wq, (((cur.ndim - 1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32)
-            else:
-                acc = lax.conv_general_dilated(
-                    xq, Wq,
-                    window_strides=st.conv_args["stride"],
-                    padding=st.conv_args["padding"],
-                    rhs_dilation=st.conv_args["dilation"],
-                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                    preferred_element_type=jnp.int32)
-            y = acc.astype(jnp.float32) * (sx * sw) + b
-            return st.activation(y).astype(self._act_dtype)
+            return _int8_forward(st.kind, Wq, sw, b, sx, st.conv_args,
+                                 st.activation, self._act_dtype, cur)
 
         return _walk_plan(self._net, self._steps, params, variables, x,
                           self._act_dtype, qstep)
@@ -288,6 +296,130 @@ def _calibrate(net, steps: List[_QStep], calib_batches: Sequence[Any]) -> None:
         x = getattr(batch, "features", batch)
         _walk_plan(net, steps, net.params, net.variables,
                    jnp.asarray(x, jnp.float32), jnp.float32, qstep)
+
+
+class _QuantizedVertexImpl:
+    """LayerImpl-shaped int8 shim for one ComputationGraph vertex.
+
+    Slots into `graph._impls[name]` so the graph's own topo-ordered forward
+    (`nn/graph.py _vertex_forward`) runs it like any layer — masks, vertex
+    types, preprocessors and mixed-precision casts all behave identically.
+    The quantized consts are closed over (they become jit constants), and
+    the incoming `params` are ignored by the int8 math. The rest of the
+    LayerImpl surface (conf, reg_loss, ...) delegates to the wrapped float
+    impl so graph methods that iterate _impls (score's _reg_loss, serde)
+    keep working; train-mode forward refuses — the quantized clone is
+    inference-only (round() has zero gradient, training would silently
+    learn nothing).
+    """
+
+    def __init__(self, float_impl, kind, Wq, w_scale, bias, x_scale,
+                 conv_args, act_dtype):
+        self._float_impl = float_impl
+        self.conf = float_impl.conf
+        self.WEIGHT_KEYS = float_impl.WEIGHT_KEYS
+        self.kind = kind
+        self.Wq = jnp.asarray(Wq)
+        self.w_scale = jnp.asarray(w_scale, jnp.float32)
+        self.bias = jnp.asarray(bias, jnp.float32)
+        self.x_scale = jnp.asarray(x_scale, jnp.float32)
+        self.activation = float_impl.activation_fn()
+        self.conv_args = conv_args or {}
+        self.act_dtype = act_dtype
+
+    def has_params(self):
+        return self._float_impl.has_params()
+
+    def reg_loss(self, params):
+        return self._float_impl.reg_loss(params)
+
+    def activation_fn(self):
+        return self.activation
+
+    def forward(self, params, x, *, train=False, rng=None, variables=None,
+                mask=None):
+        if train:
+            raise RuntimeError(
+                "quantize_graph() produces an inference-only network; "
+                "train on the float ComputationGraph and re-quantize")
+        y = _int8_forward(self.kind, self.Wq, self.w_scale, self.bias,
+                          self.x_scale, self.conv_args, self.activation,
+                          self.act_dtype, x)
+        return y, variables or {}
+
+
+def quantize_graph(net, calib_batches: Sequence[Any], *, act_dtype=None):
+    """Post-training int8 quantization of a trained ComputationGraph.
+
+    Dense and Convolution layer VERTICES are quantized (per-output-channel
+    int8 weights, calibrated per-tensor activation scales) — including
+    Dense-type output heads, whose matmul goes int8 while the softmax
+    epilogue stays f32. Every other vertex — attention, LayerNorm,
+    BatchNorm, elementwise/merge/subset, recurrent, RnnOutput heads — runs
+    its float forward unchanged inside the same jitted program. On the zoo
+    transformer that covers the embed and FFN projections, i.e. most
+    non-attention parameters. No BN folding here (a graph BN is a
+    free-standing vertex; folding would need single-producer/single-
+    consumer edge analysis for little gain).
+
+    Returns an inference-only ComputationGraph clone: output /
+    output_single / feed_forward / evaluate / score run the quantized
+    program; calling a training entry point raises. ``calib_batches``:
+    iterable of (Multi)DataSets or raw input arrays (single-input graphs).
+    """
+    net._check_init()
+    if act_dtype is None:
+        act_dtype = _compute_dtype_of(net.conf.conf)
+    conf = net.conf
+    targets: Dict[str, Any] = {}
+    for name, impl in net._impls.items():
+        if isinstance(impl, ConvolutionLayerImpl):
+            targets[name] = "conv"
+        elif type(impl) in (DenseLayerImpl, OutputLayerImpl):
+            targets[name] = "dense"
+    calib = list(calib_batches)
+    if not calib:
+        raise ValueError("quantize_graph() needs at least one calibration batch")
+
+    # calibrate: float forward per batch; a target vertex's input is its
+    # (single) source's activation run through the vertex preprocessor —
+    # exactly what _vertex_forward hands the impl
+    maxabs = {name: 0.0 for name in targets}
+    for batch in calib:
+        if hasattr(batch, "features_list"):
+            inputs = batch.features_list
+        elif hasattr(batch, "features"):
+            inputs = [batch.features]
+        else:
+            inputs = [batch]
+        acts = net.feed_forward(*[jnp.asarray(a, jnp.float32) for a in inputs],
+                                train=False)
+        for name in targets:
+            src = conf.vertex_inputs[name][0]
+            x = acts[src]
+            proc = getattr(conf.vertices[name], "preprocessor", None)
+            if proc is not None:
+                x = proc.preprocess(x)
+            maxabs[name] = max(maxabs[name], float(jnp.max(jnp.abs(x))))
+
+    qimpls = {}
+    for name, kind in targets.items():
+        p = net.params[name]
+        Wq, w_scale = _weight_qparams(np.asarray(p["W"], np.float64))
+        lconf = net._impls[name].conf
+        conv_args = (dict(stride=lconf.stride, padding=_padding_config(lconf),
+                          dilation=lconf.dilation) if kind == "conv" else None)
+        qimpls[name] = _QuantizedVertexImpl(
+            net._impls[name], kind, Wq, w_scale,
+            np.asarray(p["b"], np.float32),
+            max(maxabs[name], _EPS) / 127.0, conv_args, act_dtype)
+
+    clone = object.__new__(type(net))
+    clone.__dict__.update(net.__dict__)
+    clone._impls = {**net._impls, **qimpls}
+    clone._jit_cache = {}
+    clone._quantized_vertices = sorted(qimpls)
+    return clone
 
 
 def quantize(net, calib_batches: Sequence[Any], *, fold_bn: bool = True,

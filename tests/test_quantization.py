@@ -241,3 +241,76 @@ def test_calibration_required():
     net = _mlp_net()
     with pytest.raises(ValueError):
         quantize(net, [])
+
+
+# ---------------------------------------------------------- graph facade --
+
+def test_quantize_graph_transformer_tracks_float():
+    """Graph quantization on the zoo transformer: embed + FFN dense vertices
+    go int8, attention/LN/output stay float, logits track the float net."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.quantization import quantize_graph
+
+    rng = np.random.default_rng(7)
+    V, T, B = 13, 12, 8
+    net = ComputationGraph(transformer_lm(vocab_size=V, d_model=32,
+                                          n_heads=2, n_blocks=1)).init()
+    x = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    for _ in range(10):
+        net.fit(x, y)
+
+    qnet = quantize_graph(net, [x])
+    assert "ff0" in qnet._quantized_vertices
+    assert "embed" in qnet._quantized_vertices
+    assert "attn0" not in qnet._quantized_vertices  # attention stays float
+    assert "out" not in qnet._quantized_vertices    # RnnOutput stays float
+
+    ref = np.asarray(net.output_single(x))
+    got = np.asarray(qnet.output_single(x))
+    assert got.shape == ref.shape
+    assert np.max(np.abs(got - ref)) < 0.1
+    agree = np.mean(np.argmax(got, -1) == np.argmax(ref, -1))
+    assert agree >= 0.9, f"argmax agreement {agree}"
+    # the source net is untouched: still float, same outputs
+    np.testing.assert_array_equal(np.asarray(net.output_single(x)), ref)
+
+
+def test_quantize_graph_dense_dag():
+    """A small multi-path DAG (merge vertex) quantizes its dense vertices
+    and evaluates close to float."""
+    from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.quantization import quantize_graph
+
+    gb = (NeuralNetConfiguration.builder()
+          .seed(3).learning_rate(0.1).updater(Sgd())
+          .graph_builder()
+          .add_inputs("in")
+          .add_layer("a", DenseLayer(n_in=8, n_out=16, activation="relu"), "in")
+          .add_layer("b", DenseLayer(n_in=8, n_out=16, activation="tanh"), "in")
+          .add_vertex("m", MergeVertex(), "a", "b")
+          .add_layer("out", OutputLayer(n_in=32, n_out=4, activation="softmax",
+                                        loss="negativeloglikelihood"), "m"))
+    gb.set_outputs("out")
+    net = ComputationGraph(gb.build()).init()
+
+    rng = np.random.default_rng(8)
+    x, y = _clsdata(rng, 256, (8,), 4)
+    for _ in range(25):
+        net.fit(x, y)
+    qnet = quantize_graph(net, [x[:64]])
+    assert set(qnet._quantized_vertices) == {"a", "b", "out"}
+    ref = np.asarray(net.output_single(x))
+    got = np.asarray(qnet.output_single(x))
+    assert np.max(np.abs(got - ref)) < 0.08
+    agree = np.mean(np.argmax(got, -1) == np.argmax(ref, -1))
+    assert agree >= 0.97
+    # the clone keeps the non-forward LayerImpl surface working (reg_loss
+    # via score) and refuses training (round() has zero gradient)
+    s = qnet.score(inputs=[x[:32]], labels=[y[:32]])
+    assert np.isfinite(s)
+    with pytest.raises(RuntimeError, match="inference-only"):
+        qnet.fit(x[:32], y[:32])
