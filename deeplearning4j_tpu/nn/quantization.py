@@ -28,6 +28,7 @@ accepts ANY MultiLayerNetwork and degrades gracefully to "fold + float".
 """
 from __future__ import annotations
 
+import json
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -422,6 +423,80 @@ def quantize_graph(net, calib_batches: Sequence[Any], *, act_dtype=None):
     return clone
 
 
+QUANT_JSON = "quantization.json"
+
+# activation dtypes a persisted artifact can name (one source of truth for
+# save validation and load resolution)
+_ACT_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+               "float64": jnp.float64}
+
+
+def _finalize_steps(steps: List[_QStep]) -> None:
+    for st in steps:
+        if st.kind in ("dense", "conv"):
+            st.Wq, st.w_scale = _weight_qparams(st.Wf)
+            st.x_scale = max(st.x_maxabs, _EPS) / 127.0
+
+
+def save_quantized(qnet: QuantizedNetwork, path) -> None:
+    """Persist a quantized net: the float model checkpoint (ModelSerializer
+    zip — config + params + updater + variables) plus `quantization.json`
+    holding the calibration products (per-step activation scales, fold
+    flag, activation dtype). Weight quantization is deterministic from the
+    float params, so the scales are the only extra state; the artifact
+    stays a valid float checkpoint that `restore_multi_layer_network` can
+    also open."""
+    import zipfile
+    from ..util.model_serializer import write_model
+    dtype_name = np.dtype(qnet._act_dtype).name
+    if dtype_name not in _ACT_DTYPES:
+        raise ValueError(
+            f"act_dtype '{dtype_name}' cannot be persisted (supported: "
+            f"{sorted(_ACT_DTYPES)}) — refusing to write an unloadable "
+            "artifact")
+    write_model(qnet._net, path)
+    meta = {
+        "facade": "multilayer",
+        "fold_bn": any(s.consumed == 2 for s in qnet._steps),
+        "act_dtype": dtype_name,
+        "x_scales": {str(si): float(st.x_scale)
+                     for si, st in enumerate(qnet._steps)
+                     if st.kind in ("dense", "conv")},
+    }
+    with zipfile.ZipFile(path, "a", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(QUANT_JSON, json.dumps(meta))
+
+
+def load_quantized(path) -> QuantizedNetwork:
+    """Reload a `save_quantized` artifact: restore the float net, rebuild
+    the quantization plan deterministically, and install the persisted
+    activation scales (no recalibration data needed at load time)."""
+    import zipfile
+    from ..util.model_serializer import restore_multi_layer_network
+    with zipfile.ZipFile(path) as zf:
+        meta = json.loads(zf.read(QUANT_JSON).decode())
+    if meta.get("facade") != "multilayer":
+        raise ValueError(f"not a multilayer quantized artifact: {meta}")
+    net = restore_multi_layer_network(path)
+    act_dtype = _ACT_DTYPES.get(meta["act_dtype"])
+    if act_dtype is None:
+        raise ValueError(f"unsupported act_dtype '{meta['act_dtype']}'")
+    steps = _build_steps(net, bool(meta["fold_bn"]))
+    scales = meta["x_scales"]
+    want = {si for si, st in enumerate(steps) if st.kind in ("dense", "conv")}
+    if set(map(int, scales)) != want:
+        raise ValueError("quantization plan mismatch: saved scales cover "
+                         f"steps {sorted(scales)} but the restored net "
+                         f"quantizes steps {sorted(want)}")
+    _finalize_steps(steps)
+    for si, st in enumerate(steps):
+        if st.kind in ("dense", "conv"):
+            # install the saved scale VERBATIM (a *127/127 round trip is
+            # not bitwise-exact in double)
+            st.x_scale = float(scales[str(si)])
+    return QuantizedNetwork(net, steps, act_dtype=act_dtype)
+
+
 def quantize(net, calib_batches: Sequence[Any], *, fold_bn: bool = True,
              act_dtype=None) -> QuantizedNetwork:
     """Post-training int8 quantization of a trained MultiLayerNetwork.
@@ -441,8 +516,5 @@ def quantize(net, calib_batches: Sequence[Any], *, fold_bn: bool = True,
     if not calib:
         raise ValueError("quantize() needs at least one calibration batch")
     _calibrate(net, steps, calib)
-    for st in steps:
-        if st.kind in ("dense", "conv"):
-            st.Wq, st.w_scale = _weight_qparams(st.Wf)
-            st.x_scale = max(st.x_maxabs, _EPS) / 127.0
+    _finalize_steps(steps)
     return QuantizedNetwork(net, steps, act_dtype=act_dtype)

@@ -243,6 +243,39 @@ def test_calibration_required():
         quantize(net, [])
 
 
+def test_save_load_quantized_round_trip(tmp_path):
+    """save_quantized/load_quantized: the artifact restores to bitwise the
+    same int8 program (same consts, same scales, same outputs) and stays a
+    valid float checkpoint."""
+    from deeplearning4j_tpu.nn.quantization import (load_quantized,
+                                                    save_quantized)
+    from deeplearning4j_tpu.util.model_serializer import \
+        restore_multi_layer_network
+    rng = np.random.default_rng(12)
+    net = _conv_bn_net(seed=13)
+    x, y = _clsdata(rng, 128, (8, 8, 2), 3)
+    for _ in range(6):
+        net._fit_one(jnp.asarray(x), jnp.asarray(y), None, None)
+    qnet = quantize(net, [x[:32]])
+    p = tmp_path / "qmodel.zip"
+    save_quantized(qnet, p)
+
+    q2 = load_quantized(p)
+    assert set(qnet._consts) == set(q2._consts)
+    for (si, c1), (sj, c2) in zip(sorted(qnet._consts.items()),
+                                  sorted(q2._consts.items())):
+        assert si == sj
+        np.testing.assert_array_equal(np.asarray(c1[0]), np.asarray(c2[0]))
+        np.testing.assert_array_equal(np.asarray(c1[3]), np.asarray(c2[3]))
+    np.testing.assert_array_equal(np.asarray(qnet.output(x)),
+                                  np.asarray(q2.output(x)))
+    # still a plain float checkpoint too
+    fnet = restore_multi_layer_network(p)
+    np.testing.assert_allclose(np.asarray(fnet.output(x[:8])),
+                               np.asarray(net.output(x[:8])),
+                               rtol=1e-6, atol=1e-6)
+
+
 # ---------------------------------------------------------- graph facade --
 
 def test_quantize_graph_transformer_tracks_float():
