@@ -10,7 +10,7 @@ Usage:
                    [--runtime local|data-parallel]
   dl4j-tpu test    --model model.zip --input data.csv [--label-index I]
   dl4j-tpu predict --model model.zip --input data.csv [--output preds.csv]
-  dl4j-tpu serve   --model model.zip [--port P]
+  dl4j-tpu serve   --model model.zip [--port P] [--int8]
 """
 from __future__ import annotations
 
@@ -94,9 +94,19 @@ def cmd_serve(args) -> int:
 
     from ..serving import InferenceServer
 
-    server = InferenceServer(model_path=args.model, port=args.port,
-                             max_batch=args.max_batch).start()
-    print(f"Serving {args.model} on http://127.0.0.1:{server.port} "
+    if getattr(args, "int8", False):
+        # artifact must carry calibration (nn/quantization.save_quantized);
+        # weight quantization is rebuilt deterministically from the params
+        from ..nn.quantization import load_quantized
+        server = InferenceServer(net=load_quantized(args.model),
+                                 port=args.port,
+                                 max_batch=args.max_batch).start()
+        mode = "int8"
+    else:
+        server = InferenceServer(model_path=args.model, port=args.port,
+                                 max_batch=args.max_batch).start()
+        mode = "float"
+    print(f"Serving {args.model} ({mode}) on http://127.0.0.1:{server.port} "
           "(POST /predict, /predict/csv; GET /health, /info)")
     if args.once:  # test hook: start, report, stop
         server.stop()
@@ -150,6 +160,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--model", required=True)
     s.add_argument("--port", type=int, default=0)
     s.add_argument("--max-batch", type=int, default=1024)
+    s.add_argument("--int8", action="store_true",
+                   help="serve the int8 quantized program (the model zip "
+                        "must come from save_quantized)")
     s.add_argument("--once", action="store_true",
                    help="start and immediately stop (smoke test)")
     s.set_defaults(func=cmd_serve)

@@ -140,6 +140,7 @@ class QuantizedNetwork:
         self._steps = steps
         self._act_dtype = act_dtype
         self._jitted = None
+        self.conf = net.conf  # serving surface (/info) reads the config
         # device-resident consts: [(Wq, w_scale, bias, x_scale) per q-step]
         self._consts: Dict[int, Tuple[Array, Array, Array, Array]] = {}
         for si, st in enumerate(steps):

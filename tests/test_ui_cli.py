@@ -167,3 +167,20 @@ def test_cli_serve_smoke(tmp_path):
     path = tmp_path / "m.zip"
     write_model(net, path)
     assert cli_main(["serve", "--model", str(path), "--once"]) == 0
+
+
+def test_cli_serve_int8(tmp_path):
+    """serve --int8 loads a save_quantized artifact and serves the int8
+    program; a plain checkpoint (no calibration) is rejected."""
+    from deeplearning4j_tpu.nn.quantization import quantize, save_quantized
+    from deeplearning4j_tpu.util.model_serializer import write_model
+    net = _net()
+    x = np.random.default_rng(0).standard_normal((16, 4)).astype(np.float32)
+    qpath = tmp_path / "q.zip"
+    save_quantized(quantize(net, [x]), qpath)
+    assert cli_main(["serve", "--model", str(qpath), "--int8", "--once"]) == 0
+
+    fpath = tmp_path / "f.zip"
+    write_model(net, fpath)
+    with pytest.raises(KeyError):  # no quantization.json in the zip
+        cli_main(["serve", "--model", str(fpath), "--int8", "--once"])
