@@ -152,6 +152,11 @@ class QuantizedNetwork:
                     jnp.asarray(st.x_scale, jnp.float32),
                 )
 
+    def num_params(self) -> int:
+        """Serving surface (/health, /info): logical parameter count of the
+        underlying model — quantization changes bytes, not structure."""
+        return self._net.num_params()
+
     # -- size accounting ---------------------------------------------------
     def param_bytes(self) -> int:
         total = 0

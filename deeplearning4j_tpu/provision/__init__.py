@@ -1,5 +1,9 @@
-from .tpu_pods import (ClusterSetup, GcsTransfer, TpuPodProvisioner,
-                       ProvisionError)
+from .tpu_pods import (ClusterSetup, CommandRunner, GcsTransfer,
+                       TpuPodProvisioner, ProvisionError)
+from .storage import (GcsObjectStore, LocalObjectStore, ObjectStore,
+                      StoreDataSetIterator, sync_down, sync_up)
 
-__all__ = ["ClusterSetup", "GcsTransfer", "TpuPodProvisioner",
-           "ProvisionError"]
+__all__ = ["ClusterSetup", "CommandRunner", "GcsTransfer",
+           "TpuPodProvisioner", "ProvisionError", "ObjectStore",
+           "LocalObjectStore", "GcsObjectStore", "StoreDataSetIterator",
+           "sync_up", "sync_down"]

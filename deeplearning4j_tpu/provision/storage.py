@@ -1,0 +1,292 @@
+"""Object-store data movement + store-backed DataSet iteration.
+
+Capability parity with the reference's S3 data plumbing
+(`deeplearning4j-aws`: S3Downloader.java / S3Uploader.java bulk transfer,
+BaseS3DataSetIterator.java — iterate DataSets straight out of the bucket),
+rebuilt for the TPU substrate:
+
+  - `ObjectStore` SPI with a REAL `LocalObjectStore` (shared-filesystem /
+    NFS / gcsfuse substrate — fully executed and tested here) and a
+    `GcsObjectStore` that shells out to `gcloud storage` through the same
+    auditable dry-run `CommandRunner` the provisioners use.
+  - `sync_up` / `sync_down`: manifest-based incremental sync — SHA-256 per
+    file, unchanged files are skipped, the manifest rides in the store so
+    a re-run from any host moves only the delta (the reference re-uploads
+    blindly; a pod-slice fleet re-syncing datasets wants the delta).
+  - `StoreDataSetIterator`: iterates `.npz` DataSet shards (the same
+    features/labels format `parallel/spark_api.fit_paths` consumes)
+    directly from a store prefix, fetching lazily with a bounded local
+    cache — BaseS3DataSetIterator's contract with an explicit cache bound.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .tpu_pods import CommandRunner, ProvisionError
+
+MANIFEST_KEY = "_manifest.json"
+
+
+def _prefix_match(key: str, prefix: str) -> bool:
+    """Directory-boundary prefix semantics: 'train' matches 'train/...'
+    but NOT 'train_v2/...' (a bare startswith would bleed sibling
+    prefixes into each other)."""
+    if not prefix:
+        return True
+    return key == prefix or key.startswith(prefix + "/")
+
+
+class ObjectStore:
+    """Minimal blob-store SPI: flat string keys, whole-object transfer."""
+
+    def put(self, local: Path, key: str) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str, local: Path) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        return key in self.list(os.path.dirname(key))
+
+
+class LocalObjectStore(ObjectStore):
+    """Directory-rooted store (shared filesystem substrate) — REAL: every
+    operation executes; this is the store the tests and the zero-egress
+    environment run against. Writes are atomic (tmp + rename) so a reader
+    on another host never sees a torn object."""
+
+    def __init__(self, root):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key: str) -> Path:
+        p = (self.root / key).resolve()
+        if self.root.resolve() not in p.parents and p != self.root.resolve():
+            raise ProvisionError(f"key escapes the store root: {key}")
+        return p
+
+    def put(self, local: Path, key: str) -> None:
+        dst = self._path(key)
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=str(dst.parent), prefix=".put-")
+        os.close(fd)
+        try:
+            shutil.copyfile(local, tmp)
+            os.replace(tmp, dst)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def get(self, key: str, local: Path) -> None:
+        src = self._path(key)
+        if not src.is_file():
+            raise ProvisionError(f"no such object: {key}")
+        Path(local).parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(src, local)
+
+    def list(self, prefix: str = "") -> List[str]:
+        base = self.root
+        prefix = prefix.strip("/")
+        out = []
+        for p in base.rglob("*"):
+            if p.is_file() and not p.name.startswith(".put-"):
+                key = p.relative_to(base).as_posix()
+                if _prefix_match(key, prefix):
+                    out.append(key)
+        return sorted(out)
+
+
+class GcsObjectStore(ObjectStore):
+    """GCS store via `gcloud storage` command lines (S3Downloader/Uploader
+    analog). Auditable dry-run by default, like every provisioner in this
+    package; pass CommandRunner(dry_run=False) on a credentialed host."""
+
+    def __init__(self, bucket_uri: str,
+                 runner: Optional[CommandRunner] = None):
+        if not bucket_uri.startswith("gs://"):
+            raise ProvisionError(f"not a GCS uri: {bucket_uri}")
+        self.bucket_uri = bucket_uri.rstrip("/")
+        # delegate transfers to the package's existing S3Downloader/Uploader
+        # analog so the command building lives in ONE place
+        from .tpu_pods import GcsTransfer
+        self._transfer = GcsTransfer(runner=runner or CommandRunner())
+        self.runner = self._transfer.runner
+
+    def put(self, local: Path, key: str) -> None:
+        self._transfer.upload(str(local), f"{self.bucket_uri}/{key}",
+                              recursive=False)
+
+    def get(self, key: str, local: Path) -> None:
+        self._transfer.download(f"{self.bucket_uri}/{key}", str(local),
+                                recursive=False)
+
+    def list(self, prefix: str = "") -> List[str]:
+        prefix = prefix.strip("/")
+        glob = (f"{self.bucket_uri}/{prefix}/**" if prefix
+                else f"{self.bucket_uri}/**")
+        out = self.runner.run(["gcloud", "storage", "ls", glob])
+        base = self.bucket_uri + "/"
+        return sorted(l[len(base):] for l in out.splitlines()
+                      if l.startswith(base)
+                      and _prefix_match(l[len(base):], prefix))
+
+
+def _sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _load_manifest(store: ObjectStore, prefix: str) -> Dict[str, str]:
+    key = f"{prefix}/{MANIFEST_KEY}" if prefix else MANIFEST_KEY
+    with tempfile.TemporaryDirectory() as td:
+        local = Path(td) / "m.json"
+        try:
+            store.get(key, local)
+        except ProvisionError:
+            return {}
+        try:
+            return json.loads(local.read_text())
+        except (OSError, ValueError):
+            return {}  # torn/corrupt manifest -> full re-sync, never a crash
+
+
+def _store_manifest(store: ObjectStore, prefix: str,
+                    manifest: Dict[str, str]) -> None:
+    key = f"{prefix}/{MANIFEST_KEY}" if prefix else MANIFEST_KEY
+    with tempfile.TemporaryDirectory() as td:
+        local = Path(td) / "m.json"
+        local.write_text(json.dumps(manifest, indent=0, sort_keys=True))
+        store.put(local, key)
+
+
+def sync_up(store: ObjectStore, local_dir, prefix: str = "") -> List[str]:
+    """Incremental upload of a directory tree: files whose SHA-256 matches
+    the store manifest are skipped. Returns the list of uploaded keys."""
+    local_dir = Path(local_dir)
+    prefix = prefix.strip("/")
+    manifest = _load_manifest(store, prefix)
+    uploaded = []
+    new_manifest: Dict[str, str] = {}
+    for p in sorted(local_dir.rglob("*")):
+        if not p.is_file():
+            continue
+        rel = p.relative_to(local_dir).as_posix()
+        digest = _sha256(p)
+        new_manifest[rel] = digest
+        if manifest.get(rel) == digest:
+            continue
+        store.put(p, f"{prefix}/{rel}" if prefix else rel)
+        uploaded.append(rel)
+    _store_manifest(store, prefix, new_manifest)
+    return uploaded
+
+
+def sync_down(store: ObjectStore, prefix: str, local_dir) -> List[str]:
+    """Incremental download: objects whose local copy already matches the
+    store manifest's digest are skipped. Returns downloaded keys."""
+    local_dir = Path(local_dir)
+    local_dir.mkdir(parents=True, exist_ok=True)
+    prefix = prefix.strip("/")
+    manifest = _load_manifest(store, prefix)
+    fetched = []
+    if manifest:
+        keys = list(manifest)
+    else:  # no manifest (foreign writer): fall back to listing
+        plen = len(prefix) + 1 if prefix else 0
+        keys = [k[plen:] for k in store.list(prefix)
+                if not k.endswith(MANIFEST_KEY)]
+    for rel in sorted(keys):
+        dst = local_dir / rel
+        want = manifest.get(rel)
+        if want and dst.is_file() and _sha256(dst) == want:
+            continue
+        store.get(f"{prefix}/{rel}" if prefix else rel, dst)
+        fetched.append(rel)
+    return fetched
+
+
+class StoreDataSetIterator:
+    """Iterate DataSet shards (`.npz` with features/labels[, *_mask]) from
+    an object-store prefix (reference BaseS3DataSetIterator.java).
+
+    Shards are fetched lazily into a bounded local cache (`cache_shards`
+    newest shards kept; older evicted FIFO) so a corpus larger than local
+    disk streams through. Shard order is the sorted key order —
+    deterministic, so resumable training's replay contract holds.
+    """
+
+    def __init__(self, store: ObjectStore, prefix: str = "",
+                 cache_shards: int = 4, cache_dir=None):
+        from ..datasets.dataset import DataSet
+        self._DataSet = DataSet
+        self.store = store
+        self.prefix = prefix.strip("/")
+        self.keys = [k for k in store.list(self.prefix)
+                     if k.endswith(".npz")]
+        if not self.keys:
+            raise ProvisionError(f"no .npz shards under prefix '{prefix}'")
+        self.cache_shards = max(1, int(cache_shards))
+        self._cache_dir = Path(cache_dir) if cache_dir else \
+            Path(tempfile.mkdtemp(prefix="store_it_"))
+        self._cached: List[str] = []  # FIFO of keys resident locally
+        self._pos = 0
+
+    def _local(self, key: str) -> Path:
+        return self._cache_dir / key.replace("/", "__")
+
+    def _fetch(self, key: str) -> Path:
+        local = self._local(key)
+        if not local.is_file():
+            self.store.get(key, local)
+            self._cached.append(key)
+            while len(self._cached) > self.cache_shards:
+                old = self._cached.pop(0)
+                try:
+                    self._local(old).unlink()
+                except OSError:
+                    pass
+        return local
+
+    # -- DataSetIterator protocol ----------------------------------------
+    def reset(self) -> None:
+        self._pos = 0
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if self._pos >= len(self.keys):
+            raise StopIteration
+        key = self.keys[self._pos]
+        self._pos += 1
+        with np.load(self._fetch(key)) as z:
+            return self._DataSet(
+                np.asarray(z["features"]), np.asarray(z["labels"]),
+                features_mask=(np.asarray(z["features_mask"])
+                               if "features_mask" in z else None),
+                labels_mask=(np.asarray(z["labels_mask"])
+                             if "labels_mask" in z else None))
+
+    def next_batch(self):
+        try:
+            return self.__next__()
+        except StopIteration:
+            return None
