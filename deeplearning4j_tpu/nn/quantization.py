@@ -425,6 +425,7 @@ def quantize_graph(net, calib_batches: Sequence[Any], *, act_dtype=None):
     clone.__dict__.update(net.__dict__)
     clone._impls = {**net._impls, **qimpls}
     clone._jit_cache = {}
+    clone._rnn_state = {}  # own decode state — never share the source's
     clone._quantized_vertices = sorted(qimpls)
     return clone
 

@@ -310,6 +310,35 @@ def test_quantize_graph_transformer_tracks_float():
     np.testing.assert_array_equal(np.asarray(net.output_single(x)), ref)
 
 
+def test_quantized_graph_kv_cache_decode_matches_full():
+    """int8 streaming decode: the quantized transformer's rnn_time_step
+    (KV-cache incremental path) must match its own full forward — the
+    dense shims are deterministic per token and the attention cache is
+    the float machinery the golden KV tests already pin."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.quantization import quantize_graph
+
+    rng = np.random.default_rng(9)
+    V, T, B = 11, 8, 4
+    net = ComputationGraph(transformer_lm(vocab_size=V, d_model=32,
+                                          n_heads=2, n_blocks=1)).init()
+    x = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    for _ in range(5):
+        net.fit(x, y)
+    qnet = quantize_graph(net, [x])
+
+    full = np.asarray(qnet.output_single(x))          # [B, T, V]
+    steps = []
+    for t in range(T):
+        steps.append(np.asarray(qnet.rnn_time_step(x[:, t])[0])[:, 0])
+    cached = np.stack(steps, axis=1)
+    np.testing.assert_allclose(cached, full, rtol=2e-4, atol=2e-4)
+    # decode state lives on the clone, not the source float net
+    assert qnet._rnn_state and not net._rnn_state
+
+
 def test_quantize_graph_dense_dag():
     """A small multi-path DAG (merge vertex) quantizes its dense vertices
     and evaluates close to float."""
