@@ -134,3 +134,81 @@ def test_new_layer_configs_serde_roundtrip():
     assert back.layers[0].causal is True and back.layers[0].n_heads == 4
     assert type(back.layers[1]).__name__ == "LayerNormalization"
     assert MultiLayerConfiguration.from_yaml(conf.to_yaml()).to_json() == j
+
+
+def test_every_concrete_layer_class_roundtrips():
+    """Systematic serde sweep: EVERY concrete layer-config class survives
+    JSON and YAML round-trips inside a valid network config (reference
+    MultiLayerNeuralNetConfigurationTest covers its taxonomy the same
+    way; the earlier tests only exercised the LeNet/LSTM subset)."""
+    from deeplearning4j_tpu.nn.conf.layers import (
+        ActivationLayer, AutoEncoder, DropoutLayer, EmbeddingLayer,
+        GlobalPoolingLayer, GravesBidirectionalLSTM, GRU,
+        LocalResponseNormalization, LossLayer, RBM)
+    from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+
+    ff_stack = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.1)
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=8, activation="relu",
+                                  dropout=0.25))
+                .layer(ActivationLayer(activation="tanh"))
+                .layer(DropoutLayer(dropout=0.5))
+                .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+    js = ff_stack.to_json()
+    restored = MultiLayerConfiguration.from_json(js)
+    assert restored.to_json() == js
+    assert isinstance(restored.layers[1], ActivationLayer)
+    assert isinstance(restored.layers[2], DropoutLayer)
+    assert MultiLayerConfiguration.from_yaml(ff_stack.to_yaml()).to_json() == js
+
+    ff_cases = [
+        EmbeddingLayer(n_in=30, n_out=8),
+        RBM(n_in=6, n_out=8, visible_unit="gaussian", hidden_unit="binary"),
+        AutoEncoder(n_in=6, n_out=8, corruption_level=0.3),
+    ]
+    for layer in ff_cases:
+        conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.1)
+                .list()
+                .layer(layer)
+                .layer(OutputLayer(n_in=layer.n_out, n_out=3,
+                                   activation="softmax", loss="mcxent"))
+                .build())
+        js = conf.to_json()
+        restored = MultiLayerConfiguration.from_json(js)
+        assert restored.to_json() == js, type(layer).__name__
+        assert type(restored.layers[0]) is type(layer)
+        assert MultiLayerConfiguration.from_yaml(conf.to_yaml()).to_json() == js
+
+    rnn_cases = [
+        GravesBidirectionalLSTM(n_in=5, n_out=7, activation="tanh"),
+        GRU(n_in=5, n_out=7, activation="tanh"),
+    ]
+    for layer in rnn_cases:
+        conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.1)
+                .list()
+                .layer(layer)
+                .layer(RnnOutputLayer(n_in=7, n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        js = conf.to_json()
+        assert MultiLayerConfiguration.from_json(js).to_json() == js, \
+            type(layer).__name__
+
+    cnn_conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.1)
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(LocalResponseNormalization(k=2.0, alpha=1e-4,
+                                                  beta=0.75, n=5))
+                .layer(GlobalPoolingLayer(pooling_type="max"))
+                .layer(LossLayer(loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 2))
+                .build())
+    js = cnn_conf.to_json()
+    restored = MultiLayerConfiguration.from_json(js)
+    assert restored.to_json() == js
+    assert isinstance(restored.layers[1], LocalResponseNormalization)
+    assert isinstance(restored.layers[2], GlobalPoolingLayer)
+    assert isinstance(restored.layers[3], LossLayer)

@@ -376,3 +376,13 @@ def test_quantize_graph_dense_dag():
     assert np.isfinite(s)
     with pytest.raises(RuntimeError, match="inference-only"):
         qnet.fit(x[:32], y[:32])
+    # mesh-sharded int8 inference: the clone drops into distributed
+    # evaluation and agrees with its own local evaluate
+    from deeplearning4j_tpu.datasets.dataset import DataSet as DS
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.parallel.evaluation import distributed_evaluate
+    it = ListDataSetIterator(DS(x, y), batch=64)
+    local = qnet.evaluate(it).accuracy()
+    it.reset()
+    dist = distributed_evaluate(qnet, it).accuracy()
+    assert abs(local - dist) < 1e-9
