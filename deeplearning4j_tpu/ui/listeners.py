@@ -145,6 +145,58 @@ class ConvolutionalIterationListener(IterationListener):
                "stats": stats, "layers": layers})
 
 
+class FilterIterationListener(IterationListener):
+    """Learned convolution KERNELS rendered as image grids (the reference
+    UI's weight-render view: deeplearning4j-ui `renders/` +
+    HistogramIterationListener weight images). Each conv layer's W
+    [kh, kw, in, out] is reduced over input channels and normalized per
+    filter; the /filters page draws one tile per output channel, so filter
+    structure (edge/color detectors emerging on conv1) is visible as
+    training runs."""
+
+    def __init__(self, server_url: str, session_id: str = "default",
+                 frequency: int = 10, max_filters: int = 32):
+        self.server_url = server_url.rstrip("/")
+        self.session_id = session_id
+        self.frequency = max(1, frequency)
+        self.max_filters = max_filters
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency != 0:
+            return
+        params = model.params
+        if isinstance(params, (list, tuple)):
+            items = list(enumerate(params))
+        else:  # ComputationGraph: real vertex names in TOPOLOGICAL order
+            order = [n for n in getattr(model, "topo", sorted(params))
+                     if n in params]
+            items = [(n, params[n]) for n in order]
+        layers = []
+        for name, lp in items:
+            W = lp.get("W") if hasattr(lp, "get") else None
+            if W is None or getattr(W, "ndim", 0) != 4:
+                continue
+            arr = np.asarray(W, np.float32)           # [kh, kw, in, out]
+            mean_in = arr.mean(axis=2)                # [kh, kw, out]
+            n = min(arr.shape[-1], self.max_filters)
+            tiles = []
+            for c in range(n):
+                t = mean_in[:, :, c]
+                lo, hi = float(t.min()), float(t.max())
+                tiles.append(np.round((t - lo) / max(hi - lo, 1e-9),
+                                      3).tolist())
+            layers.append({"layer": name, "kh": int(arr.shape[0]),
+                           "kw": int(arr.shape[1]),
+                           "n_in": int(arr.shape[2]),
+                           "n_out": int(arr.shape[3]),
+                           "shown": n, "filters": tiles})
+        if not layers:
+            return
+        _post(f"{self.server_url}/filters/update?sid={self.session_id}",
+              {"iteration": iteration, "score": float(model.score_),
+               "layers": layers})
+
+
 def post_tsne(server_url: str, coords, labels=None,
               session_id: str = "default") -> None:
     """Upload a t-SNE embedding for the /tsne view (reference

@@ -30,6 +30,7 @@ _DASHBOARD = """<!DOCTYPE html>
 <body style="font-family:sans-serif">
 <h2>dl4j-tpu training UI</h2>
 <p><a href="/weights">weights</a> | <a href="/activations">activations</a> |
+<a href="/filters">filters</a> |
 <a href="/flow">flow</a> | <a href="/tsne">t-SNE view</a> |
 <a href="/nearestneighbors">nearest neighbors</a></p>
 <div id="sessions"></div>
@@ -165,6 +166,46 @@ async function refresh() {
 setInterval(refresh, 5000); refresh();
 </script></body></html>"""
 
+_FILTERS_PAGE = """<!DOCTYPE html>
+<html><head><title>filters</title></head>
+<body style="font-family:sans-serif">
+<h2>Convolution filters</h2>
+<p>learned kernels per conv layer, input-channel mean, normalized per
+filter (FilterIterationListener view)</p>
+<div id="layers"></div>
+<script>
+async function refresh() {
+  const sid = new URLSearchParams(location.search).get('sid') || 'default';
+  const d = await (await fetch('/filters/data?sid=' + sid)).json();
+  if (!d || !d.layers) return;
+  const root = document.getElementById('layers');
+  root.innerHTML = '<p>iteration ' + d.iteration + ', score ' +
+                   (d.score||0).toFixed(5) + '</p>';
+  d.layers.forEach(L => {
+    const h = document.createElement('h3');
+    const shown = (L.shown && L.shown < L.n_out)
+      ? ' (showing ' + L.shown + ' of ' + L.n_out + ')' : '';
+    h.innerText = 'layer ' + L.layer + ': ' + L.n_out + ' filters ' +
+                  L.kh + 'x' + L.kw + 'x' + L.n_in + shown;
+    root.appendChild(h);
+    L.filters.forEach(grid => {
+      const cv = document.createElement('canvas');
+      const scale = Math.max(4, Math.floor(48 / L.kh));
+      cv.width = L.kw*scale; cv.height = L.kh*scale;
+      cv.style.cssText = 'margin:2px;border:1px solid #ddd';
+      root.appendChild(cv);
+      const ctx = cv.getContext('2d');
+      grid.forEach((row,y) => row.forEach((v,x) => {
+        const g = Math.round(255*v);
+        ctx.fillStyle = 'rgb(' + g + ',' + g + ',' + g + ')';
+        ctx.fillRect(x*scale, y*scale, scale, scale);
+      }));
+    });
+  });
+}
+setInterval(refresh, 5000); refresh();
+</script></body></html>"""
+
 _FLOW_PAGE = """<!DOCTYPE html>
 <html><head><title>flow</title></head><body style="font-family:sans-serif">
 <h2>Model flow</h2>
@@ -269,6 +310,7 @@ class UiServer:
         self.flow = SessionStorage()
         self.tsne = SessionStorage()
         self.activations = SessionStorage()
+        self.filters = SessionStorage()
         self._nn_trees = {}
         server = self
 
@@ -320,6 +362,11 @@ class UiServer:
                 if url.path == "/activations/data":
                     return self._json(server.activations.get(sid, "latest")
                                       or {})
+                if url.path == "/filters":
+                    return self._html(_FILTERS_PAGE)
+                if url.path == "/filters/data":
+                    return self._json(server.filters.get(sid, "latest")
+                                      or {})
                 if url.path == "/flow":
                     return self._html(_FLOW_PAGE)
                 if url.path == "/flow/data":
@@ -355,6 +402,9 @@ class UiServer:
                     return self._json({"status": "ok"})
                 if url.path == "/activations/update":
                     server.activations.put(sid, "latest", payload)
+                    return self._json({"status": "ok"})
+                if url.path == "/filters/update":
+                    server.filters.put(sid, "latest", payload)
                     return self._json({"status": "ok"})
                 if url.path == "/tsne/update":
                     server.tsne.put(sid, "coords",

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
                                                OutputLayer, SubsamplingLayer)
 from deeplearning4j_tpu.ui.listeners import (ConvolutionalIterationListener,
+                                             FilterIterationListener,
                                              FlowIterationListener,
                                              HistogramIterationListener)
 from deeplearning4j_tpu.ui.server import UiServer
@@ -45,6 +46,7 @@ def main(iterations: int = 40, port: int = 0, keep_serving: bool = False):
     y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
     listeners = [HistogramIterationListener(server.url(), "example"),
                  FlowIterationListener(server.url(), "example"),
+                 FilterIterationListener(server.url(), "example"),
                  ConvolutionalIterationListener(server.url(), x[:1],
                                                 "example", frequency=10)]
     for it in range(iterations):

@@ -100,3 +100,59 @@ def test_flow_view_has_topology_with_param_counts():
         assert "Model flow" in page
     finally:
         server.stop()
+
+
+def test_filters_view_renders_conv_kernels():
+    """/filters: the FilterIterationListener posts normalized kernel grids
+    for every conv layer and the page + data endpoint serve them (the
+    reference UI's weight-render view)."""
+    from deeplearning4j_tpu.ui.listeners import FilterIterationListener
+    server = UiServer(port=0)
+    try:
+        net = _conv_net()
+        net.score_ = 0.5
+        FilterIterationListener(server.url(), "fs").iteration_done(net, 0)
+        d = json.loads(_get(server.url() + "/filters/data?sid=fs"))
+        assert d["iteration"] == 0
+        assert len(d["layers"]) == 1  # one conv layer in _conv_net
+        L = d["layers"][0]
+        assert (L["kh"], L["kw"], L["n_in"], L["n_out"]) == (3, 3, 1, 4)
+        assert len(L["filters"]) == 4
+        grid = np.asarray(L["filters"][0])
+        assert grid.shape == (3, 3)
+        assert 0.0 <= grid.min() and grid.max() <= 1.0
+        page = _get(server.url() + "/filters")
+        assert "Convolution filters" in page
+        # dashboard links the view
+        assert '/filters' in _get(server.url() + "/")
+
+        # truncation is explicit: max_filters=2 caps tiles, payload says so
+        FilterIterationListener(server.url(), "fs2",
+                                max_filters=2).iteration_done(net, 0)
+        d2 = json.loads(_get(server.url() + "/filters/data?sid=fs2"))
+        L2 = d2["layers"][0]
+        assert L2["shown"] == 2 and L2["n_out"] == 4
+        assert len(L2["filters"]) == 2
+
+        # ComputationGraph: vertices labeled by NAME in topological order
+        # ('z_stem' precedes 'a_head' topologically but not alphabetically)
+        from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        gb = (NeuralNetConfiguration.builder()
+              .seed(2).learning_rate(0.05).updater(Sgd())
+              .graph_builder()
+              .add_inputs("in")
+              .add_layer("z_stem", ConvolutionLayer(
+                  n_in=1, n_out=2, kernel_size=(3, 3), padding=(1, 1),
+                  activation="relu"), "in")
+              .add_layer("a_head", ConvolutionLayer(
+                  n_in=2, n_out=3, kernel_size=(3, 3), padding=(1, 1),
+                  activation="identity"), "z_stem"))
+        gb.set_outputs("a_head")
+        gnet = ComputationGraph(gb.build()).init()
+        gnet.score_ = 0.1
+        FilterIterationListener(server.url(), "gs").iteration_done(gnet, 0)
+        dg = json.loads(_get(server.url() + "/filters/data?sid=gs"))
+        assert [L["layer"] for L in dg["layers"]] == ["z_stem", "a_head"]
+    finally:
+        server.stop()
