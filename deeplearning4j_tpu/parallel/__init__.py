@@ -14,6 +14,7 @@ from .pipeline import GPipeExecutor, stack_block_params
 from .moe import MoEExecutor
 from .spark_api import SparkComputationGraph, SparkDl4jMultiLayer
 from .tensor_parallel import shard_transformer_tp
+from .zero import shard_updater_state, updater_state_bytes_per_device
 from .evaluation import (DistributedDataSetLossCalculator,
                          DistributedEarlyStoppingTrainer,
                          distributed_evaluate, distributed_score)
@@ -28,6 +29,7 @@ __all__ = [
     "TrainingStateTracker", "AsyncTrainingStateTracker", "fit_with_recovery", "ConfigurationRegistry",
     "GPipeExecutor", "stack_block_params", "MoEExecutor",
     "SparkDl4jMultiLayer", "SparkComputationGraph", "shard_transformer_tp",
+    "shard_updater_state", "updater_state_bytes_per_device",
     "distributed_evaluate", "distributed_score",
     "DistributedDataSetLossCalculator", "DistributedEarlyStoppingTrainer",
     "full_attention", "ring_attention", "ulysses_attention",

@@ -1,0 +1,115 @@
+"""ZeRO-1 cross-replica updater-state sharding (parallel/zero.py).
+
+Contract: training with sharded optimizer state is numerically golden-equal
+to replicated training, the state actually stays sharded across steps (the
+memory win survives the step function), and per-device state bytes drop by
+the data-axis factor for the shardable tensors.
+"""
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.zoo import mlp_iris
+from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater.updaters import Adam
+from deeplearning4j_tpu.parallel import IciDataParallelTrainingMaster
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from deeplearning4j_tpu.parallel.zero import (shard_updater_state,
+                                              updater_state_bytes_per_device)
+
+
+def _adam_net(seed=5):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(1e-2).updater(Adam())
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=32, activation="relu"))
+            .layer(DenseLayer(n_in=32, n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_in=32, n_out=4, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=128):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return x, y
+
+
+def _require_multidevice(mesh):
+    import pytest
+    if mesh.shape[DATA_AXIS] < 2:
+        pytest.skip("needs a multi-device mesh")
+
+
+def test_zero1_sharded_training_is_golden_equal():
+    mesh = default_mesh()
+    _require_multidevice(mesh)
+    x, y = _data()
+    batches = [DataSet(x[i:i + 32], y[i:i + 32]) for i in range(0, 128, 32)]
+
+    ref = _adam_net()
+    IciDataParallelTrainingMaster(mesh=mesh).execute_training(
+        ref, iter(batches))
+
+    z = _adam_net()
+    n_sharded, n_total = shard_updater_state(z, mesh)
+    assert n_sharded >= 4  # Adam m+v for the two 32-wide dense layers
+    IciDataParallelTrainingMaster(mesh=mesh).execute_training(
+        z, iter(batches))
+
+    np.testing.assert_allclose(ref.params_flat(), z.params_flat(),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(ref.updater_state_flat(),
+                               z.updater_state_flat(), rtol=1e-6, atol=1e-6)
+
+
+def test_zero1_state_stays_sharded_through_steps():
+    """The step function must PRESERVE the state sharding — if GSPMD decided
+    to replicate the outputs, the memory saving would silently vanish after
+    one step."""
+    mesh = default_mesh()
+    n_dev = mesh.shape[DATA_AXIS]
+    _require_multidevice(mesh)
+    x, y = _data()
+    net = _adam_net()
+    shard_updater_state(net, mesh)
+    before = updater_state_bytes_per_device(net)
+    master = IciDataParallelTrainingMaster(mesh=mesh)
+    master.execute_training(net, iter([DataSet(x[:64], y[:64])]))
+
+    sharded = 0
+    for leaf in jax.tree_util.tree_leaves(net.updater_state):
+        s = getattr(leaf, "sharding", None)
+        if isinstance(s, NamedSharding) and any(
+                p is not None for p in (s.spec or ())):
+            sharded += 1
+    assert sharded >= 4, "state sharding lost in the train step"
+    after = updater_state_bytes_per_device(net)
+    assert after <= before * 1.01  # no replication blow-up after the step
+
+
+def test_zero1_per_device_bytes_shrink():
+    mesh = default_mesh()
+    _require_multidevice(mesh)
+    n_dev = mesh.shape[DATA_AXIS]
+    net = _adam_net()
+    # baseline: un-sharded state (host arrays count at full logical size)
+    full = updater_state_bytes_per_device(net)
+    shard_updater_state(net, mesh)
+    sharded = updater_state_bytes_per_device(net)
+    # the 32-wide tensors shard n_dev-fold; small biases stay replicated
+    assert sharded < full * (0.3 if n_dev >= 8 else 0.8)
+
+
+def test_zero1_on_zoo_model():
+    """mlp_iris (SGD momentum-free updater states may be empty) — the helper
+    must handle empty/odd state trees gracefully."""
+    mesh = default_mesh()
+    net = MultiLayerNetwork(mlp_iris()).init()
+    n_sharded, n_total = shard_updater_state(net, mesh)
+    assert n_total >= 0  # no crash is the contract here
