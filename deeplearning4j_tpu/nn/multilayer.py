@@ -290,13 +290,10 @@ class MultiLayerNetwork:
             new_ustates.append(lu)
         return new_params, new_ustates
 
-    def _build_train_step(self, key, in_scan: bool = False):
-        """Build the raw (unjitted) pure train step — reused by the
-        distributed trainers (parallel/) inside shard_map. ``in_scan`` marks
-        steps traced inside a lax.scan body (remat drops its CSE barriers
-        there; see layers/base.remat_forward)."""
-        has_fmask, has_lmask, carry_state = key
-
+    def _build_loss_fn(self, carry_state: bool, in_scan: bool):
+        """The pure training loss (batch mean + regularization) with aux
+        (new variables, new rnn states) — shared by the train step and the
+        gradient-accumulation step."""
         def loss_fn(params, variables, x, y, fmask, lmask, rng, states):
             acts, new_vars, new_states, preout = self._forward_impl(
                 params, variables, x, train=True, rng=rng, fmask=fmask,
@@ -306,6 +303,15 @@ class MultiLayerNetwork:
             loss = (self._loss_from_output(out, y, lmask, preout=preout)
                     + self._reg_loss(params))
             return loss.astype(jnp.float32), (new_vars, new_states)
+        return loss_fn
+
+    def _build_train_step(self, key, in_scan: bool = False):
+        """Build the raw (unjitted) pure train step — reused by the
+        distributed trainers (parallel/) inside shard_map. ``in_scan`` marks
+        steps traced inside a lax.scan body (remat drops its CSE barriers
+        there; see layers/base.remat_forward)."""
+        has_fmask, has_lmask, carry_state = key
+        loss_fn = self._build_loss_fn(carry_state, in_scan)
 
         def train_step(params, variables, ustates, step, rng, x, y, fmask, lmask, states):
             (loss, (new_vars, new_states)), grads = jax.value_and_grad(
@@ -321,6 +327,98 @@ class MultiLayerNetwork:
         fn = jax.jit(self._build_train_step(key), donate_argnums=(0, 2))
         self._jit_cache[key] = fn
         return fn
+
+    # ------------------------------------------- gradient accumulation ------
+    def _build_accum_step(self, key):
+        """ONE optimizer update from K accumulated microbatch gradients, as
+        one device program (beyond the reference; the HBM lever for batches
+        that don't fit — each microbatch's activations are freed before the
+        next runs under lax.scan). Each microbatch loss is a batch MEAN, so
+        sum/K is exactly the full-batch mean gradient for batch-independent
+        layers; BatchNorm uses per-MICRObatch statistics (the standard
+        large-model behavior — document, don't hide)."""
+        has_fmask, has_lmask = key
+        loss_fn = self._build_loss_fn(carry_state=False, in_scan=True)
+
+        def accum_step(params, variables, ustates, step, rng, xs, ys, fms, lms):
+            k = xs.shape[0]
+            gzero = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+            def body(carry, inp):
+                gsum, variables = carry
+                x, y, fm, lm, i = inp
+                sub = jax.random.fold_in(rng, i)
+                (loss, (new_vars, _)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(
+                        params, variables, x, y,
+                        fm if has_fmask else None,
+                        lm if has_lmask else None, sub, None)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                return (gsum, new_vars), loss
+
+            dummy = jnp.zeros((k,), jnp.float32)
+            (gsum, new_vars), losses = jax.lax.scan(
+                body, (gzero, variables),
+                (xs, ys, fms if has_fmask else dummy,
+                 lms if has_lmask else dummy, jnp.arange(k)))
+            grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
+            new_params, new_ustates = self._apply_updaters(
+                params, grads, ustates, step)
+            return new_params, new_vars, new_ustates, losses
+
+        return accum_step
+
+    def fit_batch_accumulated(self, x, y, accumulation_steps: int,
+                              fmask=None, lmask=None):
+        """Train ONE optimizer step on a batch too large for HBM by
+        accumulating gradients over `accumulation_steps` microbatches
+        (batch size must divide evenly). Equivalent to `fit_batch` on the
+        full batch for BatchNorm-free, unmasked nets (golden-tested); with
+        BatchNorm statistics are per-microbatch, and with label masks the
+        per-microbatch weighted means make it an approximation unless mask
+        weight is uniform across microbatches. Returns the mean microbatch
+        loss."""
+        self._check_init()
+        algo = (self.conf.conf.optimization_algo or
+                "stochastic_gradient_descent").lower()
+        if (algo not in ("stochastic_gradient_descent", "sgd")
+                or self.conf.conf.iterations > 1):
+            raise ValueError(
+                "fit_batch_accumulated supports SGD-family training with "
+                f"iterations=1 (got algo={algo!r}, "
+                f"iterations={self.conf.conf.iterations}); use fit_batch "
+                "for solver-based optimization")
+        k = int(accumulation_steps)
+        if k <= 0:
+            raise ValueError(f"accumulation_steps must be >= 1 (got {k})")
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if x.shape[0] % k:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by "
+                f"accumulation_steps {k}")
+        b = x.shape[0] // k
+
+        def split(a):
+            return (None if a is None else
+                    jnp.asarray(a).reshape((k, b) + tuple(a.shape[1:])))
+
+        key = (fmask is not None, lmask is not None)
+        ck = ("accum",) + key
+        if ck not in self._jit_cache:
+            self._jit_cache[ck] = jax.jit(self._build_accum_step(key),
+                                          donate_argnums=(0, 2))
+        self._key, sub = jax.random.split(self._key)
+        (self.params, self.variables, self.updater_state,
+         losses) = self._jit_cache[ck](
+            self.params, self.variables, self.updater_state,
+            jnp.asarray(self.step), sub, split(x), split(y),
+            split(fmask), split(lmask))
+        self.step += 1
+        mean_loss = jnp.mean(losses)
+        self.score_ = mean_loss  # lazy: reading .score_ fetches it later
+        for listener in self.listeners:
+            listener.iteration_done(self, self.step)
+        return mean_loss  # device scalar — no blocking host fetch here
 
     # ------------------------------------------------- multi-step (scan) -----
     def _build_multi_step(self, key):

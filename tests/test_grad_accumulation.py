@@ -1,0 +1,97 @@
+"""Gradient accumulation (nn/multilayer.fit_batch_accumulated).
+
+Contract: one optimizer update from K accumulated microbatch gradients is
+EXACTLY the full-batch update for batch-independent (BatchNorm-free,
+dropout-free) nets, state advances once, and invalid splits are rejected.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater.updaters import Adam
+
+
+def _net(seed=3):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(1e-2).updater(Adam())
+            .regularization(True).l2(1e-4)
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=24, activation="relu"))
+            .layer(DenseLayer(n_in=24, n_out=24, activation="tanh"))
+            .layer(OutputLayer(n_in=24, n_out=4, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64):
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((n, 6)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return x, y
+
+
+def test_accumulated_update_equals_full_batch():
+    x, y = _data(64)
+    a, b = _net(), _net()
+    for _ in range(5):  # several steps so updater state (Adam m/v) matters
+        a.fit_batch(x, y)
+        b.fit_batch_accumulated(x, y, accumulation_steps=4)
+    np.testing.assert_allclose(a.params_flat(), b.params_flat(),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(a.updater_state_flat(),
+                               b.updater_state_flat(), rtol=2e-5, atol=2e-6)
+    assert a.step == b.step == 5
+    # reported loss: mean of microbatch means == full-batch mean
+    assert abs(float(a.score_) - float(b.score_)) < 1e-4
+
+
+def test_accumulated_k1_equals_fit_batch():
+    x, y = _data(32)
+    a, b = _net(7), _net(7)
+    a.fit_batch(x, y)
+    b.fit_batch_accumulated(x, y, accumulation_steps=1)
+    np.testing.assert_allclose(a.params_flat(), b.params_flat(),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_accumulation_rejects_indivisible_batch():
+    x, y = _data(30)
+    net = _net()
+    with pytest.raises(ValueError, match="not divisible"):
+        net.fit_batch_accumulated(x, y, accumulation_steps=4)
+    with pytest.raises(ValueError, match="must be >= 1"):
+        net.fit_batch_accumulated(x, y, accumulation_steps=0)
+
+
+def test_accumulation_rejects_solver_configs():
+    """Non-SGD optimization must raise, not silently train with the wrong
+    algorithm (review finding)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1).updater(Adam())
+            .optimization_algo("lbfgs")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=4, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x, y = _data(16)
+    with pytest.raises(ValueError, match="SGD-family"):
+        net.fit_batch_accumulated(x, y, accumulation_steps=2)
+
+
+def test_accumulation_trains_to_accuracy():
+    rng = np.random.default_rng(2)
+    yid = rng.integers(0, 4, 256)
+    x = rng.standard_normal((256, 6)).astype(np.float32) * 0.5
+    x += yid[:, None].astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[yid]
+    net = _net(11)
+    for _ in range(60):
+        net.fit_batch_accumulated(x, y, accumulation_steps=8)
+    pred = net.predict(x)
+    assert (pred == yid).mean() > 0.9
