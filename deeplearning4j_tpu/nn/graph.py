@@ -304,14 +304,9 @@ class ComputationGraph:
             new_ustates[name] = lu
         return new_params, new_ustates
 
-    def _build_train_step(self, in_scan: bool = False):
-        """Raw (unjitted) pure train step — reused by the distributed
-        trainers (parallel/) inside shard_map, mirroring
-        MultiLayerNetwork._build_train_step. (jit retraces per input pytree
-        structure, so no shape key is needed here; _get_train_step's key is
-        purely a cache discriminator.) ``in_scan`` marks steps traced inside
-        a lax.scan body (remat drops its CSE barriers there)."""
-
+    def _build_loss_fn(self, in_scan: bool = False):
+        """The pure training loss with aux (new variables) — shared by the
+        train step and the gradient-accumulation step."""
         def loss_fn(params, variables, inputs, labels, fmasks, lmasks, rng):
             acts, new_vars, _, preouts = self._forward_impl(
                 params, variables, inputs, train=True, rng=rng, fmasks=fmasks,
@@ -319,6 +314,16 @@ class ComputationGraph:
             loss = (self._loss(acts, labels, lmasks, preouts=preouts)
                     + self._reg_loss(params))
             return loss, new_vars
+        return loss_fn
+
+    def _build_train_step(self, in_scan: bool = False):
+        """Raw (unjitted) pure train step — reused by the distributed
+        trainers (parallel/) inside shard_map, mirroring
+        MultiLayerNetwork._build_train_step. (jit retraces per input pytree
+        structure, so no shape key is needed here; _get_train_step's key is
+        purely a cache discriminator.) ``in_scan`` marks steps traced inside
+        a lax.scan body (remat drops its CSE barriers there)."""
+        loss_fn = self._build_loss_fn(in_scan)
 
         def train_step(params, variables, ustates, step, rng, inputs, labels,
                        fmasks, lmasks):
@@ -360,6 +365,81 @@ class ComputationGraph:
         fn = jax.jit(self._build_train_step(), donate_argnums=(0, 2))
         self._jit_cache[key] = fn
         return fn
+
+    # ------------------------------------------- gradient accumulation ------
+    def _build_accum_step(self):
+        """ONE optimizer update from K accumulated microbatch gradients
+        (mirrors MultiLayerNetwork._build_accum_step; unmasked inputs)."""
+        loss_fn = self._build_loss_fn(in_scan=True)
+
+        def accum_step(params, variables, ustates, step, rng, xs_t, ys_t):
+            k = xs_t[0].shape[0]
+            gzero = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+            def body(carry, inp):
+                gsum, variables = carry
+                xs_i, ys_i, i = inp
+                sub = jax.random.fold_in(rng, i)
+                (loss, new_vars), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, variables, list(xs_i),
+                                           list(ys_i), None, None, sub)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                return (gsum, new_vars), loss
+
+            (gsum, new_vars), losses = jax.lax.scan(
+                body, (gzero, variables), (xs_t, ys_t, jnp.arange(k)))
+            grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
+            new_params, new_ustates = self._apply_updaters(
+                params, grads, ustates, step)
+            return new_params, new_vars, new_ustates, losses
+
+        return accum_step
+
+    def fit_batch_accumulated(self, inputs, labels, accumulation_steps: int):
+        """One optimizer step from `accumulation_steps` accumulated
+        microbatch gradients (see MultiLayerNetwork.fit_batch_accumulated;
+        the batch axis of every input/label must divide evenly; unmasked).
+        Returns the device-resident mean microbatch loss."""
+        self._check_init()
+        algo = (self.conf.conf.optimization_algo or
+                "stochastic_gradient_descent").lower()
+        if (algo not in ("stochastic_gradient_descent", "sgd")
+                or self.conf.conf.iterations > 1):
+            raise ValueError(
+                "fit_batch_accumulated supports SGD-family training with "
+                f"iterations=1 (got algo={algo!r}, "
+                f"iterations={self.conf.conf.iterations})")
+        k = int(accumulation_steps)
+        if k <= 0:
+            raise ValueError(f"accumulation_steps must be >= 1 (got {k})")
+        ins = [jnp.asarray(a) for a in (inputs if isinstance(inputs, (list, tuple))
+                                        else [inputs])]
+        outs = [jnp.asarray(a) for a in (labels if isinstance(labels, (list, tuple))
+                                         else [labels])]
+        for a in ins + outs:
+            if a.shape[0] % k:
+                raise ValueError(f"batch {a.shape[0]} not divisible by "
+                                 f"accumulation_steps {k}")
+
+        def split(a):
+            return a.reshape((k, a.shape[0] // k) + tuple(a.shape[1:]))
+
+        ck = ("accum", len(ins), len(outs))
+        if ck not in self._jit_cache:
+            self._jit_cache[ck] = jax.jit(self._build_accum_step(),
+                                          donate_argnums=(0, 2))
+        self._key, sub = jax.random.split(self._key)
+        (self.params, self.variables, self.updater_state,
+         losses) = self._jit_cache[ck](
+            self.params, self.variables, self.updater_state,
+            jnp.asarray(self.step), sub,
+            tuple(split(a) for a in ins), tuple(split(a) for a in outs))
+        self.step += 1
+        mean_loss = jnp.mean(losses)
+        self.score_ = mean_loss
+        for listener in self.listeners:
+            listener.iteration_done(self, self.step)
+        return mean_loss
 
     # -- fit -------------------------------------------------------------------
     def fit(self, data, labels=None):

@@ -84,6 +84,28 @@ def test_accumulation_rejects_solver_configs():
         net.fit_batch_accumulated(x, y, accumulation_steps=2)
 
 
+def test_graph_accumulated_equals_full_batch():
+    """ComputationGraph facade: accumulated transformer update == full-batch
+    update (attention/LN are batch-independent)."""
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    rng = np.random.default_rng(4)
+    V, T, B = 11, 8, 16
+    x = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    a = ComputationGraph(transformer_lm(vocab_size=V, d_model=16,
+                                        n_heads=2, n_blocks=1)).init()
+    b = ComputationGraph(transformer_lm(vocab_size=V, d_model=16,
+                                        n_heads=2, n_blocks=1)).init()
+    for _ in range(3):
+        a.fit(x, y)
+        b.fit_batch_accumulated(x, y, accumulation_steps=4)
+    np.testing.assert_allclose(np.asarray(a.params_flat()),
+                               np.asarray(b.params_flat()),
+                               rtol=3e-5, atol=3e-6)
+    assert a.step == b.step == 3
+
+
 def test_accumulation_trains_to_accuracy():
     rng = np.random.default_rng(2)
     yid = rng.integers(0, 4, 256)
