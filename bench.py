@@ -617,9 +617,14 @@ def main() -> None:
 
     # ---- 9. int8 post-training-quantized inference A/B (beyond reference;
     # nn/quantization.py). Reuses the convergence-trained AlexNet: BN folded
-    # into convs, per-channel int8 weights, calibrated activation scales —
-    # the MXU's s8 path at 2x bf16 peak. No floor: the row is evidence for
-    # the capability, win or lose, like the kernel A/B rows. --------------
+    # into convs, per-channel int8 weights, calibrated activation scales.
+    # No floor: the row is evidence for the capability, win or lose, like
+    # the kernel A/B rows — and the honest finding is that on this model
+    # XLA's s8 conv path does NOT approach its 2x peak: interleaved
+    # best-vs-best measured 0.74-1.04x at compute-bound batches
+    # (B=2048/4096) and up to 1.4x only when a slow tunnel regime throttled
+    # the float baseline. The capability's measured value is MEMORY (~4x
+    # weight bytes vs f32) and exact accuracy, not throughput. ------------
     try:
         from deeplearning4j_tpu.nn.quantization import quantize
         cit.reset()
@@ -628,19 +633,25 @@ def main() -> None:
         xb = jnp.asarray(calib.features)
         B = int(xb.shape[0])
 
-        def _infer_time(fn, iters=50, blocks=3):
-            fn(xb).block_until_ready()  # compile + warm
-            best = float("inf")
-            for _ in range(blocks):
-                t0 = time.perf_counter()
-                for _i in range(iters):
-                    out = fn(xb)
-                out.block_until_ready()
-                best = min(best, (time.perf_counter() - t0) / iters)
-            return best
+        def _block(fn, iters):
+            t0 = time.perf_counter()
+            for _i in range(iters):
+                out = fn(xb)
+            out.block_until_ready()
+            return (time.perf_counter() - t0) / iters
 
-        t_f = _infer_time(lambda a: cnet.output(a))
-        t_q = _infer_time(lambda a: qnet.output(a))
+        # INTERLEAVED A/B (f,q,f,q,...): tunnel throughput drifts on the
+        # minutes scale, so back-to-back blocks see the same regime — a
+        # float-block-then-int8-block protocol measured drift as a fake
+        # delta in both directions across sessions
+        f_fn = lambda a: cnet.output(a)   # noqa: E731
+        q_fn = lambda a: qnet.output(a)   # noqa: E731
+        f_fn(xb).block_until_ready()      # compile + warm both programs
+        q_fn(xb).block_until_ready()
+        t_f = t_q = float("inf")
+        for _ in range(4):
+            t_f = min(t_f, _block(f_fn, 50))
+            t_q = min(t_q, _block(q_fn, 50))
         cit.reset()
         qacc = qnet.evaluate(cit).accuracy()
         facc = WORKLOADS["alexnet_cifar10"].get(ckey)
@@ -654,7 +665,11 @@ def main() -> None:
             "param_bytes_ratio": round(qnet.param_bytes() /
                                        qnet.float_param_bytes(), 3),
             "note": f"B={B} batch inference, BN-folded per-channel int8 "
-                    "weights, calibrated per-tensor activation scales",
+                    "weights, calibrated per-tensor activation scales; "
+                    "interleaved A/B blocks (tunnel drift would otherwise "
+                    "read as a fake delta); the capability's measured win "
+                    "is weight bytes + exact accuracy, not throughput "
+                    "(XLA s8 conv ~parity with bf16 on this model)",
         }
     except Exception as e:
         WORKLOADS["alexnet_cifar10_int8"] = {"error": str(e)}
