@@ -290,6 +290,8 @@ class ComputationGraph:
             if base_lr is None or base_lr < 0:
                 base_lr = layer_conf.learning_rate
             bias_lr = layer_conf.bias_learning_rate or base_lr
+            wd = float(getattr(updater, "weight_decay", 0.0) or 0.0)
+            wkeys = self._impls[name].WEIGHT_KEYS
             lp, lu = {}, {}
             for pname, g in lgrads.items():
                 lr0 = bias_lr if pname in ("b", "vb", "beta") else base_lr
@@ -298,7 +300,10 @@ class ComputationGraph:
                                   gconf.lr_policy_steps, gconf.max_num_iterations,
                                   gconf.lr_schedule).astype(g.dtype)
                 delta, ns = updater.apply(ustates[name][pname], g, lr, step)
-                lp[pname] = params[name][pname] + delta
+                p = params[name][pname]
+                if wd and pname in wkeys:  # decoupled (AdamW-style) decay
+                    delta = delta - lr * jnp.asarray(wd, p.dtype) * p
+                lp[pname] = p + delta
                 lu[pname] = ns
             new_params[name] = lp
             new_ustates[name] = lu

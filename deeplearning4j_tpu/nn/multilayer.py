@@ -276,6 +276,8 @@ class MultiLayerNetwork:
             if updater_lr is None or updater_lr < 0:
                 base_lr = layer_conf.learning_rate
             bias_lr = layer_conf.bias_learning_rate or base_lr
+            wd = float(getattr(updater, "weight_decay", 0.0) or 0.0)
+            wkeys = self._impls[i].WEIGHT_KEYS
             lp, lu = {}, {}
             for name, g in lgrads.items():
                 lr0 = bias_lr if name in ("b", "vb", "beta") else base_lr
@@ -284,7 +286,10 @@ class MultiLayerNetwork:
                                   gconf.lr_policy_steps, gconf.max_num_iterations,
                                   gconf.lr_schedule).astype(g.dtype)
                 delta, new_state = updater.apply(ustates[i][name], g, lr, step)
-                lp[name] = params[i][name] + delta
+                p = params[i][name]
+                if wd and name in wkeys:  # decoupled (AdamW-style) decay
+                    delta = delta - lr * jnp.asarray(wd, p.dtype) * p
+                lp[name] = p + delta
                 lu[name] = new_state
             new_params.append(lp)
             new_ustates.append(lu)

@@ -96,10 +96,17 @@ class Nesterovs(UpdaterConfig):
 @register
 @dataclass
 class Adam(UpdaterConfig):
+    """Adam; with ``weight_decay > 0`` this is AdamW (decoupled decay,
+    Loshchilov & Hutter): the decay is applied to the PARAMETER at the
+    update site (nn/multilayer._apply_updaters), scaled by the effective
+    lr and restricted to weight tensors — unlike `.l2(...)`, it never
+    enters the adaptive moments. No reference counterpart (0.4-era)."""
+
     learning_rate: float = -1.0
     beta1: float = 0.9
     beta2: float = 0.999
     epsilon: float = _EPS_DEFAULT
+    weight_decay: float = 0.0
 
     def init_state(self, param):
         return _f32_state(param, ("m", "u"))

@@ -120,3 +120,104 @@ def test_grad_clip_l2_per_param():
     out = apply_gradient_normalization(g, "ClipL2PerParamType", 5.0)
     np.testing.assert_allclose(np.linalg.norm(np.asarray(out["W"])), 5.0, rtol=1e-4)
     np.testing.assert_allclose(np.asarray(out["b"]), [0.1], rtol=1e-5)
+
+
+# -- decoupled weight decay (AdamW) --------------------------------------------
+
+def _wd_net(wd):
+    from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.1)
+            .updater(Adam(weight_decay=wd))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_adamw_decoupled_decay_exact():
+    """One step of Adam(weight_decay=wd) == one step of plain Adam minus
+    lr*wd*W on WEIGHT tensors only (the Loshchilov-Hutter decoupling —
+    never through the adaptive moments); biases are untouched by decay."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    wd, lr = 0.05, 0.1
+    a = _wd_net(0.0)
+    b = _wd_net(wd)
+    # host copies BEFORE the step: the jitted step donates param buffers
+    w0 = [np.asarray(lp["W"]) for lp in b.params]
+    a.fit_batch(x, y)
+    b.fit_batch(x, y)
+    for i in (0, 1):
+        np.testing.assert_allclose(
+            np.asarray(b.params[i]["W"]),
+            np.asarray(a.params[i]["W"]) - lr * wd * w0[i],
+            rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(b.params[i]["b"]),
+                                   np.asarray(a.params[i]["b"]),
+                                   rtol=1e-6, atol=1e-8)
+
+
+def test_adamw_differs_from_coupled_l2():
+    """Decoupled decay is NOT .l2(): the trajectories diverge (L2 feeds the
+    adaptive moments; AdamW does not)."""
+    from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    l2conf = (NeuralNetConfiguration.builder()
+              .seed(3).learning_rate(0.1).updater(Adam())
+              .regularization(True).l2(0.05)
+              .list()
+              .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+              .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                 loss="negativeloglikelihood"))
+              .build())
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork as MLN
+    l2net = MLN(l2conf).init()
+    wdnet = _wd_net(0.05)
+    for _ in range(10):
+        l2net.fit_batch(x, y)
+        wdnet.fit_batch(x, y)
+    assert not np.allclose(l2net.params_flat(), wdnet.params_flat(),
+                           rtol=1e-3)
+
+
+def test_adamw_graph_facade():
+    """The graph facade applies the same decoupled decay."""
+    from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+
+    def g(wd):
+        gb = (NeuralNetConfiguration.builder()
+              .seed(5).learning_rate(0.1).updater(Adam(weight_decay=wd))
+              .graph_builder()
+              .add_inputs("in")
+              .add_layer("h", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                         "in")
+              .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                            activation="softmax",
+                                            loss="negativeloglikelihood"),
+                         "h"))
+        gb.set_outputs("out")
+        return ComputationGraph(gb.build()).init()
+
+    a, b = g(0.0), g(0.05)
+    w0 = np.asarray(b.params["h"]["W"])
+    a.fit(x, y)
+    b.fit(x, y)
+    np.testing.assert_allclose(
+        np.asarray(b.params["h"]["W"]),
+        np.asarray(a.params["h"]["W"]) - 0.1 * 0.05 * w0,
+        rtol=1e-5, atol=1e-7)
