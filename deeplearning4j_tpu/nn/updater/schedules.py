@@ -10,7 +10,8 @@ from typing import Dict, Optional
 
 import jax.numpy as jnp
 
-POLICIES = ("none", "exponential", "inverse", "poly", "sigmoid", "step", "schedule")
+POLICIES = ("none", "exponential", "inverse", "poly", "sigmoid", "step",
+            "schedule", "warmup_cosine")
 
 
 def effective_lr(
@@ -46,4 +47,16 @@ def effective_lr(
         for k, v in sorted((int(k), float(v)) for k, v in (schedule or {}).items()):
             out = jnp.where(it >= k, v, out)
         return out
+    if policy == "warmup_cosine":
+        # beyond reference (transformer-era default): linear warmup over
+        # `steps` iterations from 0 to base_lr, then cosine decay to
+        # base_lr*decay_rate by max_iterations
+        warm = jnp.maximum(float(steps), 1.0)
+        floor_frac = jnp.asarray(decay_rate, jnp.float32)
+        warm_lr = lr * it / warm
+        span = jnp.maximum(float(max_iterations) - warm, 1.0)
+        prog = jnp.clip((it - warm) / span, 0.0, 1.0)
+        cos_lr = lr * (floor_frac + (1.0 - floor_frac)
+                       * 0.5 * (1.0 + jnp.cos(jnp.pi * prog)))
+        return jnp.where(it < warm, warm_lr, cos_lr)
     raise ValueError(f"Unknown lr policy '{policy}'. Available: {POLICIES}")

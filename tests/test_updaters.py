@@ -221,3 +221,18 @@ def test_adamw_graph_facade():
         np.asarray(b.params["h"]["W"]),
         np.asarray(a.params["h"]["W"]) - 0.1 * 0.05 * w0,
         rtol=1e-5, atol=1e-7)
+
+
+def test_warmup_cosine_schedule():
+    """warmup_cosine: linear 0->base over `steps`, cosine base->floor by
+    max_iterations (beyond reference; the transformer-era default)."""
+    lr = lambda it: float(effective_lr(  # noqa: E731
+        0.1, it, "warmup_cosine", decay_rate=0.1, steps=10,
+        max_iterations=110))
+    np.testing.assert_allclose(lr(0), 0.0, atol=1e-8)
+    np.testing.assert_allclose(lr(5), 0.05, rtol=1e-5)      # mid-warmup
+    np.testing.assert_allclose(lr(10), 0.1, rtol=1e-5)      # peak
+    np.testing.assert_allclose(lr(60), 0.1 * (0.1 + 0.9 * 0.5),
+                               rtol=1e-4)                   # cosine midpoint
+    np.testing.assert_allclose(lr(110), 0.01, rtol=1e-4)    # floor
+    np.testing.assert_allclose(lr(500), 0.01, rtol=1e-4)    # clamped after
