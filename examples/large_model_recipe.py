@@ -1,0 +1,86 @@
+"""The large-model training recipe: every memory/throughput lever at once.
+
+Composes, on the zoo transformer, the pieces a large-model run uses
+together (all individually golden-tested; this example proves they
+compose):
+
+  phase 1 (single device):
+    - AdamW (decoupled weight decay) + warmup_cosine LR schedule
+    - gradient accumulation: one update from K microbatch gradients
+    - async checkpointing: save() never stalls the step loop
+  phase 2 (device mesh):
+    - ICI data-parallel master with ZeRO-1 optimizer-state sharding
+    - resume from the phase-1 checkpoint
+
+Run: python examples/large_model_recipe.py
+(on a non-TPU host: JAX_PLATFORMS=cpu, optionally
+ XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual mesh)
+"""
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+
+def main(steps: int = 8, accum: int = 4, vocab: int = 13, d_model: int = 32,
+         seq: int = 12, batch: int = 16) -> float:
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.parallel import (AsyncTrainingStateTracker,
+                                             IciDataParallelTrainingMaster,
+                                             shard_updater_state,
+                                             updater_state_bytes_per_device)
+    from deeplearning4j_tpu.parallel.mesh import default_mesh
+
+    rng = np.random.default_rng(0)
+    x = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (batch, seq))]
+    y = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (batch, seq))]
+
+    # AdamW + warmup_cosine via the ordinary config DSL
+    conf = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=2,
+                          n_blocks=1, lr=3e-3)
+    for layer in conf.vertices.values():
+        if getattr(layer, "layer", None) is not None:
+            layer.layer.updater.weight_decay = 0.01
+    conf.conf.lr_policy = "warmup_cosine"
+    conf.conf.lr_policy_steps = 4
+    conf.conf.lr_policy_decay_rate = 0.1
+    conf.conf.max_num_iterations = steps * 2
+
+    net = ComputationGraph(conf).init()
+    ckpt_dir = Path(tempfile.mkdtemp(prefix="recipe_ckpt_"))
+    losses = []
+    with AsyncTrainingStateTracker(ckpt_dir, every_n_batches=2) as tracker:
+        for i in range(steps):
+            loss = net.fit_batch_accumulated(x, y, accumulation_steps=accum)
+            losses.append(loss)  # device scalars — fetch once at the end
+            tracker.batch_done(net, {"phase": 1, "step": i + 1})
+        tracker.save(net, {"phase": 1, "step": steps})
+        tracker.wait()
+        first, last = float(losses[0]), float(losses[-1])
+        print(f"phase 1: {steps} accumulated steps (K={accum}), "
+              f"loss {first:.3f} -> {last:.3f}, "
+              f"checkpoint {tracker.latest().name}")
+
+        # phase 2: resume on the mesh with sharded optimizer state
+        mesh = default_mesh()
+        net2 = ComputationGraph(conf).init()
+        tracker.restore(net2)
+        n_sharded, n_total = shard_updater_state(net2, mesh)
+        per_dev = updater_state_bytes_per_device(net2)
+        master = IciDataParallelTrainingMaster(mesh=mesh)
+        master.execute_training(
+            net2, iter([DataSet(x, y)] * steps))
+        final = float(net2.score_)
+        print(f"phase 2: resumed on data={mesh.shape['data']} mesh, "
+              f"ZeRO-1 sharded {n_sharded}/{n_total} state tensors "
+              f"({per_dev} bytes/device), loss -> {final:.3f}")
+    assert np.isfinite(final) and final <= first * 1.5
+    return final
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,12 @@ def test_transformer_example():
     assert acc > 0.8
 
 
+def test_large_model_recipe_example():
+    import large_model_recipe
+    final = large_model_recipe.main(steps=4, accum=2, batch=8)
+    assert final == final  # finite (asserted inside) and returned
+
+
 def test_quantized_inference_example():
     import quantized_inference
     assert quantized_inference.main(epochs=1, n=96, batch=48) == 4
