@@ -34,10 +34,17 @@ class ConfusionMatrix:
 class Evaluation:
     """Streaming classification metrics (reference eval/Evaluation.java)."""
 
-    def __init__(self, n_classes: Optional[int] = None, labels: Optional[List[str]] = None):
+    def __init__(self, n_classes: Optional[int] = None,
+                 labels: Optional[List[str]] = None, top_n: int = 1):
         self.n_classes = n_classes
         self.label_names = labels
         self.confusion: Optional[ConfusionMatrix] = None
+        # top-N accuracy (Evaluation(topN) in post-reference DL4J): counted
+        # from the full prediction rows since the confusion matrix can't
+        # recover "was the true class in the N best"
+        self.top_n = max(1, int(top_n))
+        self._top_n_correct = 0
+        self._top_n_total = 0
 
     def _ensure(self, n: int):
         if self.confusion is None:
@@ -63,6 +70,11 @@ class Evaluation:
         actual = np.argmax(labels, axis=-1)
         guess = np.argmax(predictions, axis=-1)
         self.confusion.add_batch(actual, guess)
+        if self.top_n > 1 and len(actual):
+            n = min(self.top_n, predictions.shape[-1])
+            top = np.argpartition(predictions, -n, axis=-1)[:, -n:]
+            self._top_n_correct += int((top == actual[:, None]).any(-1).sum())
+            self._top_n_total += len(actual)
 
     # -- metrics ---------------------------------------------------------------
     def _tp(self, i):
@@ -78,6 +90,14 @@ class Evaluation:
         m = self.confusion.matrix
         total = m.sum()
         return float(np.trace(m) / total) if total else 0.0
+
+    def top_n_accuracy(self) -> float:
+        """Fraction of examples whose true class was among the top_n
+        predicted (== accuracy() when top_n == 1)."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        return (self._top_n_correct / self._top_n_total
+                if self._top_n_total else 0.0)
 
     def precision(self, cls: Optional[int] = None) -> float:
         if cls is not None:

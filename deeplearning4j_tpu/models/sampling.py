@@ -14,14 +14,25 @@ import numpy as np
 
 
 def _sample_logits(probs: np.ndarray, temperature: float, top_k: Optional[int],
-                   rng: np.random.Generator) -> int:
-    """Pick a token id from one probability row [V]."""
+                   rng: np.random.Generator,
+                   top_p: Optional[float] = None) -> int:
+    """Pick a token id from one probability row [V]. ``top_p`` (nucleus
+    sampling) keeps the smallest set of tokens whose cumulative probability
+    reaches p; composes with top_k (both filters apply)."""
     if temperature <= 0.0:  # greedy
         return int(probs.argmax())
     logits = np.log(np.maximum(probs, 1e-30)) / temperature
     if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
         cutoff = np.partition(logits, -top_k)[-top_k]
         logits = np.where(logits >= cutoff, logits, -np.inf)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        order = np.argsort(logits)[::-1]
+        lmax = logits[order[0]]
+        ps = np.exp(logits[order] - lmax)
+        ps /= ps.sum()
+        keep_n = int(np.searchsorted(np.cumsum(ps), top_p) + 1)
+        drop = order[keep_n:]
+        logits[drop] = -np.inf
     logits = logits - logits.max()
     p = np.exp(logits)
     p /= p.sum()
@@ -30,7 +41,8 @@ def _sample_logits(probs: np.ndarray, temperature: float, top_k: Optional[int],
 
 def generate_transformer(net, prompt_ids: Sequence[int], n_tokens: int,
                          vocab_size: int, *, temperature: float = 0.0,
-                         top_k: Optional[int] = None, seed: int = 0,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None, seed: int = 0,
                          max_context: Optional[int] = None,
                          use_cache: bool = False) -> list:
     """Continue `prompt_ids` by `n_tokens` using a transformer_lm
@@ -80,7 +92,7 @@ def generate_transformer(net, prompt_ids: Sequence[int], n_tokens: int,
             probs = np.asarray(
                 net.rnn_time_step(onehot(prompt_ids))[0])[0, -1]
             for i in range(n_tokens):
-                nxt = _sample_logits(probs, temperature, top_k, rng)
+                nxt = _sample_logits(probs, temperature, top_k, rng, top_p)
                 out.append(nxt)
                 if i + 1 < n_tokens:  # the final token needs no forward pass
                     probs = np.asarray(
@@ -92,7 +104,7 @@ def generate_transformer(net, prompt_ids: Sequence[int], n_tokens: int,
     for _ in range(n_tokens):
         ctx = np.asarray(ids if max_context is None else ids[-max_context:])
         probs = np.asarray(net.output(onehot(ctx))[0])[0, -1]
-        nxt = _sample_logits(probs, temperature, top_k, rng)
+        nxt = _sample_logits(probs, temperature, top_k, rng, top_p)
         ids.append(nxt)
         out.append(nxt)
     return out
@@ -100,7 +112,8 @@ def generate_transformer(net, prompt_ids: Sequence[int], n_tokens: int,
 
 def generate_rnn(net, prompt_ids: Sequence[int], n_tokens: int,
                  vocab_size: int, *, temperature: float = 0.0,
-                 top_k: Optional[int] = None, seed: int = 0) -> list:
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, seed: int = 0) -> list:
     """Continue `prompt_ids` by `n_tokens` with a recurrent
     MultiLayerNetwork via stateful O(1)-memory `rnn_time_step`
     (reference rnnTimeStep:1460 streaming inference)."""
@@ -120,7 +133,7 @@ def generate_rnn(net, prompt_ids: Sequence[int], n_tokens: int,
     out = []
     for _ in range(n_tokens):
         row = probs[0, -1] if probs.ndim == 3 else probs[0]
-        nxt = _sample_logits(row, temperature, top_k, rng)
+        nxt = _sample_logits(row, temperature, top_k, rng, top_p)
         out.append(nxt)
         probs = step(nxt)
     return out

@@ -844,9 +844,9 @@ class ComputationGraph:
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
 
-    def evaluate(self, iterator):
+    def evaluate(self, iterator, top_n: int = 1):
         from ..evaluation.evaluation import Evaluation
-        ev = Evaluation()
+        ev = Evaluation(top_n=top_n)
         for ds in iterator:
             fm = getattr(ds, "features_mask", None)
             out = self.output(ds.features,

@@ -928,9 +928,9 @@ class MultiLayerNetwork:
             net.step = self.step
         return net
 
-    def evaluate(self, iterator):
+    def evaluate(self, iterator, top_n: int = 1):
         from ..evaluation.evaluation import Evaluation
-        ev = Evaluation()
+        ev = Evaluation(top_n=top_n)
         for ds in iterator:
             out = self.output(ds.features,
                               fmask=getattr(ds, "features_mask", None))

@@ -195,9 +195,9 @@ class QuantizedNetwork:
     def predict(self, x) -> np.ndarray:
         return np.asarray(jnp.argmax(self.output(x), axis=-1))
 
-    def evaluate(self, iterator):
+    def evaluate(self, iterator, top_n: int = 1):
         from ..evaluation.evaluation import Evaluation
-        ev = Evaluation()
+        ev = Evaluation(top_n=top_n)
         for ds in iterator:
             ev.eval(np.asarray(ds.labels), np.asarray(self.output(ds.features)))
         return ev

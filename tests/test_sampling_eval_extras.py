@@ -1,0 +1,165 @@
+"""Top-p (nucleus) sampling, top-N accuracy, and Polyak/EMA weights.
+
+Three small beyond-reference capabilities added in round 5; each pinned by
+exact-math checks.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+from deeplearning4j_tpu.models.sampling import _sample_logits
+from deeplearning4j_tpu.optimize.listeners import PolyakAveragingListener
+
+
+# -- nucleus (top-p) sampling ------------------------------------------------
+
+def test_top_p_restricts_support():
+    """p=0.5 over [0.4, 0.3, 0.2, 0.1] keeps exactly {0, 1} (cumsum reaches
+    0.5 at the 2nd token); samples never leave the nucleus."""
+    probs = np.asarray([0.4, 0.3, 0.2, 0.1])
+    rng = np.random.default_rng(0)
+    seen = {_sample_logits(probs.copy(), 1.0, None, rng, top_p=0.5)
+            for _ in range(200)}
+    assert seen == {0, 1}
+
+
+def test_top_p_one_keeps_everything():
+    probs = np.asarray([0.25, 0.25, 0.25, 0.25])
+    rng = np.random.default_rng(1)
+    seen = {_sample_logits(probs.copy(), 1.0, None, rng, top_p=1.0)
+            for _ in range(300)}
+    assert seen == {0, 1, 2, 3}  # top_p=1.0 is a no-op filter
+
+
+def test_top_p_composes_with_top_k():
+    probs = np.asarray([0.4, 0.3, 0.2, 0.1])
+    rng = np.random.default_rng(2)
+    # top_k=3 drops index 3; renormalized [0.444, 0.333, 0.222] then
+    # p=0.4 keeps only index 0 (its renormalized mass already covers p)
+    seen = {_sample_logits(probs.copy(), 1.0, 3, rng, top_p=0.4)
+            for _ in range(100)}
+    assert seen == {0}
+
+
+def test_generate_rnn_accepts_top_p():
+    from deeplearning4j_tpu.models.zoo import char_rnn_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(char_rnn_lstm(vocab_size=9, hidden=12)).init()
+    from deeplearning4j_tpu.models.sampling import generate_rnn
+    out = generate_rnn(net, [1, 2], 5, 9, temperature=0.8, top_p=0.9, seed=3)
+    assert len(out) == 5 and all(0 <= t < 9 for t in out)
+
+
+# -- top-N accuracy ----------------------------------------------------------
+
+def test_top_n_accuracy_exact():
+    ev = Evaluation(top_n=2)
+    y = np.eye(4, dtype=np.float32)[[0, 1, 2, 3]]
+    # row 0: true class ranked 1st; rows 1,2: ranked 2nd; row 3: ranked 3rd
+    p = np.asarray([
+        [0.7, 0.1, 0.1, 0.1],
+        [0.5, 0.4, 0.05, 0.05],
+        [0.1, 0.5, 0.4, 0.0],
+        [0.4, 0.3, 0.2, 0.1],
+    ], np.float32)
+    ev.eval(y, p)
+    assert ev.accuracy() == pytest.approx(0.25)       # only row 0 top-1
+    assert ev.top_n_accuracy() == pytest.approx(0.75)  # rows 0,1,2 in top-2
+
+
+def test_top_n_defaults_to_accuracy():
+    ev = Evaluation()
+    y = np.eye(3, dtype=np.float32)[[0, 1]]
+    p = np.asarray([[0.9, 0.05, 0.05], [0.1, 0.2, 0.7]], np.float32)
+    ev.eval(y, p)
+    assert ev.top_n_accuracy() == ev.accuracy() == pytest.approx(0.5)
+
+
+# -- Polyak / EMA weights ----------------------------------------------------
+
+def test_ema_listener_exact_math_and_swap():
+    from deeplearning4j_tpu.models.zoo import mlp_iris
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    net = MultiLayerNetwork(mlp_iris()).init()
+    ema = PolyakAveragingListener(decay=0.5)
+    net.set_listeners(ema)
+
+    manual = None
+    for _ in range(4):
+        net.fit_batch(x, y)
+        p = np.asarray(net.params_flat())
+        manual = p if manual is None else 0.5 * manual + 0.5 * p
+
+    trained = np.asarray(net.params_flat())
+    with ema.swapped_in(net):
+        np.testing.assert_allclose(np.asarray(net.params_flat()), manual,
+                                   rtol=1e-6, atol=1e-7)
+        assert not np.allclose(np.asarray(net.params_flat()), trained)
+        # inference runs under EMA weights
+        out = net.output(x)
+        assert np.all(np.isfinite(np.asarray(out)))
+    # restored after the context
+    np.testing.assert_array_equal(np.asarray(net.params_flat()), trained)
+
+
+def test_ema_listener_validation():
+    with pytest.raises(ValueError):
+        PolyakAveragingListener(decay=1.5)
+    with pytest.raises(ValueError):
+        PolyakAveragingListener(decay=0.9).ema_params()
+
+
+def test_ema_dedupes_identical_snapshots():
+    """fit(iterator)'s scan path fires iteration_done K times with the SAME
+    end-of-chunk params; identical snapshots must count as ONE EMA update
+    (review finding: silent d^K decay)."""
+    from deeplearning4j_tpu.models.zoo import mlp_iris
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(mlp_iris()).init()
+    ema = PolyakAveragingListener(decay=0.5)
+    ema.iteration_done(net, 0)
+    seeded = np.asarray(ema.ema_params()[0]["W"])
+    for i in range(5):                      # same params object -> no-ops
+        ema.iteration_done(net, i + 1)
+    np.testing.assert_array_equal(np.asarray(ema.ema_params()[0]["W"]),
+                                  seeded)
+
+
+def test_ema_survives_training_while_swapped_in():
+    """Training while EMA weights are installed must not delete the
+    listener's EMA tree (review finding: donation of the installed
+    buffers)."""
+    from deeplearning4j_tpu.models.zoo import mlp_iris
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net = MultiLayerNetwork(mlp_iris()).init()
+    ema = PolyakAveragingListener(decay=0.9)
+    net.fit_batch(x, y)
+    ema.iteration_done(net, 0)
+    with ema.swapped_in(net):
+        net.fit_batch(x, y)  # donates the INSTALLED copy, not the EMA
+    flat = np.concatenate([np.asarray(a).ravel()
+                           for a in ema.ema_params()[0].values()])
+    assert np.all(np.isfinite(flat))  # EMA tree still alive and readable
+
+
+def test_evaluate_top_n_plumbed_through_facades():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.models.zoo import mlp_iris
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    net = MultiLayerNetwork(mlp_iris()).init()
+    it = ListDataSetIterator(DataSet(x, y), batch=32)
+    ev = net.evaluate(it, top_n=2)
+    assert ev.top_n == 2
+    assert ev.top_n_accuracy() >= ev.accuracy()
+    # with 3 classes, top-2 of an untrained softmax is well above top-1
+    assert ev.top_n_accuracy() > 0.33
