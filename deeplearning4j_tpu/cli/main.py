@@ -10,7 +10,8 @@ Usage:
                    [--runtime local|data-parallel]
   dl4j-tpu test    --model model.zip --input data.csv [--label-index I]
   dl4j-tpu predict --model model.zip --input data.csv [--output preds.csv]
-  dl4j-tpu serve   --model model.zip [--port P] [--int8]
+  dl4j-tpu serve   --model model.zip [--port P] [--int8] [--no-batching]
+                   [--batch-window-ms MS] [--queue-size N] [--timeout-ms MS]
 """
 from __future__ import annotations
 
@@ -94,20 +95,26 @@ def cmd_serve(args) -> int:
 
     from ..serving import InferenceServer
 
+    kw = dict(port=args.port, max_batch=args.max_batch,
+              batching=not args.no_batching,
+              batch_window_ms=args.batch_window_ms,
+              max_queue=args.queue_size,
+              default_timeout_ms=args.timeout_ms)
     if getattr(args, "int8", False):
         # artifact must carry calibration (nn/quantization.save_quantized);
         # weight quantization is rebuilt deterministically from the params
         from ..nn.quantization import load_quantized
-        server = InferenceServer(net=load_quantized(args.model),
-                                 port=args.port,
-                                 max_batch=args.max_batch).start()
+        server = InferenceServer(net=load_quantized(args.model), **kw).start()
         mode = "int8"
     else:
-        server = InferenceServer(model_path=args.model, port=args.port,
-                                 max_batch=args.max_batch).start()
+        server = InferenceServer(model_path=args.model, **kw).start()
         mode = "float"
-    print(f"Serving {args.model} ({mode}) on http://127.0.0.1:{server.port} "
-          "(POST /predict, /predict/csv; GET /health, /info)")
+    batch_mode = ("lock-serialized" if args.no_batching else
+                  f"micro-batched, window {args.batch_window_ms}ms, "
+                  f"queue {args.queue_size}")
+    print(f"Serving {args.model} ({mode}, {batch_mode}) on "
+          f"http://127.0.0.1:{server.port} "
+          "(POST /predict, /predict/csv; GET /health, /info, /metrics)")
     if args.once:  # test hook: start, report, stop
         server.stop()
         return 0
@@ -163,6 +170,19 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--int8", action="store_true",
                    help="serve the int8 quantized program (the model zip "
                         "must come from save_quantized)")
+    s.add_argument("--no-batching", action="store_true",
+                   help="disable continuous micro-batching (fall back to "
+                        "the lock-serialized direct path)")
+    s.add_argument("--batch-window-ms", type=float, default=2.0,
+                   help="how long the collator waits for more requests "
+                        "after the first arrival (latency/occupancy knob)")
+    s.add_argument("--queue-size", type=int, default=256,
+                   help="bounded request queue; beyond it requests get "
+                        "HTTP 503 (backpressure)")
+    s.add_argument("--timeout-ms", type=float, default=None,
+                   help="default per-request deadline; expired requests "
+                        "get HTTP 504 (clients can override per request "
+                        "with ?timeout_ms=)")
     s.add_argument("--once", action="store_true",
                    help="start and immediately stop (smoke test)")
     s.set_defaults(func=cmd_serve)

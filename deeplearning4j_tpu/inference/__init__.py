@@ -1,0 +1,18 @@
+"""Production inference engine: continuous micro-batching, slot-based
+generative decode scheduling, and SLO metrics.
+
+The three pieces compose into the serving stack (`serving/server.py`):
+`MicroBatcher` aggregates concurrent `/predict` requests into bucketed
+padded batches; `DecodeScheduler` continuously batches generative decode
+over the attention KV cache; `MetricsRegistry` records queue depth, batch
+occupancy, and latency percentiles, exported at `GET /metrics`.
+"""
+from .batcher import (InferenceFuture, MicroBatcher, QueueFullError,
+                      RequestTimeoutError)
+from .engine import DecodeHandle, DecodeScheduler
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      default_registry)
+
+__all__ = ["Counter", "DecodeHandle", "DecodeScheduler", "Gauge",
+           "Histogram", "InferenceFuture", "MetricsRegistry", "MicroBatcher",
+           "QueueFullError", "RequestTimeoutError", "default_registry"]

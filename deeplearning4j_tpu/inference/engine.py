@@ -1,0 +1,337 @@
+"""Slot-based continuous-batching decode scheduler for generative LMs.
+
+`models/sampling.generate_transformer` decodes ONE sequence at a time: a
+serving host running it back-to-back leaves (slots-1)/slots of every decode
+step's batch dimension empty. This engine is the Orca-style iteration-level
+scheduler (continuous batching) over the existing attention KV cache:
+
+  - a fixed number of decode *slots* (the batch dimension of one shared,
+    per-layer KV cache / recurrent state pytree);
+  - each engine step runs ALL slots through ONE jitted single-token
+    forward — the XLA program is compiled exactly once, for the
+    [n_slots, 1, vocab] shape, and never recompiles as sequences come
+    and go;
+  - new sequences are admitted into free slots *between* steps (their
+    slot's state rows are zeroed and, for attention layers, the per-slot
+    cache position — `nn/layers/attention.py` vector-``pos`` plumbing —
+    restarts at 0; stale K/V beyond a row's own position is causally
+    masked, so slot reuse needs no cache wipe to be correct);
+  - finished sequences (max tokens or EOS) are evicted the step they
+    finish, freeing the slot for the next queued request.
+
+Prompts are prefilled token-by-token through the same step — prefill and
+decode are one program, which is what keeps admission recompile-free. Token
+selection reuses `models/sampling.sample_logits`, so greedy engine output
+is token-identical to solo `generate_transformer(use_cache=True)` decoding
+(tested), and seeded sampled output matches too (same per-sequence RNG
+consumption order).
+
+Works for both facades: transformer ComputationGraphs (KV-cache states)
+and recurrent MultiLayerNetworks (h/c states — admitting a sequence zeroes
+its slot's rows).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.sampling import sample_logits
+from ..nn.layers.recurrent import (BaseRecurrentImpl,
+                                   _materialize_rnn_states)
+from ..nn.multilayer import _compute_dtype_of
+from .metrics import MetricsRegistry, default_registry
+
+
+class DecodeHandle:
+    """Completion handle for one submitted generation request."""
+
+    def __init__(self, prompt_len: int, max_new_tokens: int):
+        self.prompt_len = prompt_len
+        self.max_new_tokens = max_new_tokens
+        self.tokens: List[int] = []
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+
+    def _finish(self, err: Optional[BaseException] = None) -> None:
+        self._error = err
+        self.t_done = time.monotonic()
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation not finished")
+        if self._error is not None:
+            raise self._error
+        return self.tokens
+
+
+class _ActiveSeq:
+    """Book-keeping for one slot-resident sequence."""
+    __slots__ = ("handle", "prompt", "fed", "rng", "temperature", "top_k",
+                 "top_p", "eos_id")
+
+    def __init__(self, handle: DecodeHandle, prompt: Sequence[int],
+                 temperature: float, top_k: Optional[int],
+                 top_p: Optional[float], seed: int, eos_id: Optional[int]):
+        self.handle = handle
+        self.prompt = [int(t) for t in prompt]
+        self.fed = 0  # prompt tokens fed so far
+        self.rng = np.random.default_rng(seed)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+
+    def next_input(self) -> int:
+        """Token to feed this step: the next prompt token while prefilling,
+        else the last generated token."""
+        if self.fed < len(self.prompt):
+            return self.prompt[self.fed]
+        return self.handle.tokens[-1]
+
+    @property
+    def sampling(self) -> bool:
+        """Past the last prompt token, every step's output is sampled."""
+        return self.fed >= len(self.prompt)
+
+
+class DecodeScheduler:
+    """Continuous-batching decode over a shared model and KV cache.
+
+    ``net``: a trained ComputationGraph (e.g. `models/zoo.transformer_lm`,
+    causal attention) or recurrent MultiLayerNetwork whose output is a
+    next-token distribution. The engine owns a private state pytree — it
+    never touches ``net._rnn_state``, so callers may keep using the net's
+    own streaming API concurrently (single-threaded model access is still
+    required; the engine's step thread is that single thread while
+    running).
+    """
+
+    def __init__(self, net, vocab_size: int, *, n_slots: int = 4,
+                 max_queue: int = 64,
+                 metrics: Optional[MetricsRegistry] = None):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.net = net
+        self.vocab_size = int(vocab_size)
+        self.n_slots = int(n_slots)
+        self.max_queue = int(max_queue)
+        self.metrics = metrics if metrics is not None else default_registry()
+        self._graph = hasattr(net.conf, "vertices")  # facade detection
+        self._dtype = _compute_dtype_of(net.conf.conf)
+        self._cache_cap = self._min_cache_len()
+        self._states = self._init_states()
+        self._slots: List[Optional[_ActiveSeq]] = [None] * self.n_slots
+        self._queue: List[_ActiveSeq] = []
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._jstep = jax.jit(self._step_fn)
+        m = self.metrics
+        self._m_queue_depth = m.gauge("decode_queue_depth")
+        self._m_active = m.gauge("decode_active_slots")
+        self._m_occupancy = m.histogram("decode_slot_occupancy", lo=1.0,
+                                        hi=float(self.n_slots) + 1,
+                                        per_decade=12)
+        self._m_tokens = m.counter("decode_tokens_total")
+        self._m_seqs = m.counter("decode_sequences_total")
+        self._m_rejected = m.counter("decode_rejected_total")
+        self._m_latency = m.histogram("decode_seq_latency_sec")
+        self._m_ttft = m.histogram("decode_time_to_first_token_sec")
+        self._m_step_time = m.histogram("decode_step_time_sec")
+
+    # -- model plumbing ----------------------------------------------------
+    def _impl_items(self):
+        impls = self.net._impls
+        return impls.items() if isinstance(impls, dict) else enumerate(impls)
+
+    def _min_cache_len(self) -> Optional[int]:
+        caps = []
+        for _, impl in self._impl_items():
+            if type(impl).__name__ == "SelfAttentionLayerImpl":
+                caps.append(int(getattr(impl.conf, "max_cache_len", 1024)))
+        return min(caps) if caps else None
+
+    def _init_states(self) -> Dict[Any, Any]:
+        """Private per-layer state with batch dim = n_slots; attention
+        cache positions become [n_slots] vectors so each slot decodes at
+        its own depth."""
+        states = _materialize_rnn_states(self._impl_items(), {},
+                                         self.n_slots, self._dtype)
+        for key, st in states.items():
+            if isinstance(st, dict) and "pos" in st and st["pos"].ndim == 0:
+                states[key] = {**st,
+                               "pos": jnp.zeros((self.n_slots,), jnp.int32)}
+        return states
+
+    def _step_fn(self, params, variables, x, states):
+        """One single-token forward for all slots: [n_slots, 1, V] one-hot
+        in, last-position next-token distribution [n_slots, V] out."""
+        if self._graph:
+            acts, _, new_states = self.net._forward_impl(
+                params, variables, [x], train=False, rng=None, states=states)
+            out = acts[self.net.conf.network_outputs[0]]
+        else:
+            acts, _, new_states = self.net._forward_impl(
+                params, variables, x, train=False, rng=None, states=states)
+            out = acts[-1]
+        return out[:, -1, :], new_states
+
+    def _reset_slot_state(self, slot: int) -> None:
+        """Zero one slot's rows across every state leaf (KV rows, cache
+        position, LSTM h/c) so an admitted sequence starts clean."""
+        def zero_row(a):
+            if hasattr(a, "ndim") and a.ndim >= 1 and \
+                    a.shape[0] == self.n_slots:
+                return a.at[slot].set(0)
+            return a
+        self._states = jax.tree_util.tree_map(zero_row, self._states)
+
+    def _reset_idle_positions(self, idle: List[int]) -> None:
+        """Pin idle slots' cache positions back to 0 (they are stepped with
+        zero inputs as part of the batch, so their depth would otherwise
+        creep toward the cache cap). Their stale K/V needs no wipe — it is
+        zeroed at admission and causally masked until then."""
+        if not idle:
+            return
+        idx = jnp.asarray(idle)
+        for key, st in self._states.items():
+            if isinstance(st, dict) and "pos" in st and st["pos"].ndim:
+                self._states[key] = {**st,
+                                     "pos": st["pos"].at[idx].set(0)}
+
+    # -- client side -------------------------------------------------------
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               top_p: Optional[float] = None, seed: int = 0,
+               eos_id: Optional[int] = None) -> DecodeHandle:
+        if not len(prompt_ids):
+            raise ValueError("prompt_ids must be non-empty")
+        if self._cache_cap is not None:
+            needed = len(prompt_ids) + max(max_new_tokens - 1, 0)
+            if needed > self._cache_cap:
+                raise ValueError(
+                    f"prompt ({len(prompt_ids)}) + max_new_tokens "
+                    f"({max_new_tokens}) needs a KV cache of {needed} but "
+                    f"max_cache_len={self._cache_cap}")
+        handle = DecodeHandle(len(prompt_ids), max_new_tokens)
+        seq = _ActiveSeq(handle, prompt_ids, temperature, top_k, top_p,
+                         seed, eos_id)
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("scheduler is not running (call start())")
+            if len(self._queue) >= self.max_queue:
+                self._m_rejected.inc()
+                raise RuntimeError(
+                    f"decode queue full ({self.max_queue} waiting)")
+            self._queue.append(seq)
+            self._m_queue_depth.set(len(self._queue))
+            self._cond.notify()
+        return handle
+
+    def generate(self, prompt_ids: Sequence[int], max_new_tokens: int,
+                 timeout: Optional[float] = 120.0, **kw) -> List[int]:
+        """Blocking submit — drop-in for `generate_transformer` greedy."""
+        return self.submit(prompt_ids, max_new_tokens, **kw).result(timeout)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "DecodeScheduler":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="decode-scheduler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            pending = self._queue[:]
+            self._queue.clear()
+            self._cond.notify_all()
+        for seq in pending:
+            seq.handle._finish(RuntimeError("scheduler stopped"))
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        for i, seq in enumerate(self._slots):
+            if seq is not None:
+                seq.handle._finish(RuntimeError("scheduler stopped"))
+                self._slots[i] = None
+
+    # -- scheduler loop ----------------------------------------------------
+    def _admit(self) -> None:
+        with self._cond:
+            for i in range(self.n_slots):
+                if self._slots[i] is not None or not self._queue:
+                    continue
+                seq = self._queue.pop(0)
+                self._reset_slot_state(i)
+                self._slots[i] = seq
+                self._m_seqs.inc()
+            self._m_queue_depth.set(len(self._queue))
+            self._m_active.set(sum(s is not None for s in self._slots))
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if not self._running:
+                    return  # stop() fails any still-active handles
+            self._admit()
+            active = [(i, s) for i, s in enumerate(self._slots)
+                      if s is not None]
+            if not active:
+                with self._cond:
+                    if not self._running:
+                        return
+                    if not self._queue:
+                        self._cond.wait(timeout=0.1)
+                continue
+            t0 = time.monotonic()
+            x = np.zeros((self.n_slots, 1, self.vocab_size), np.float32)
+            for i, seq in active:
+                x[i, 0, seq.next_input()] = 1.0
+            probs, new_states = self._jstep(self.net.params,
+                                            self.net.variables,
+                                            jnp.asarray(x), self._states)
+            self._states = new_states
+            probs = np.asarray(probs)
+            self._m_occupancy.record(len(active))
+            self._m_step_time.record(time.monotonic() - t0)
+            for i, seq in active:
+                was_sampling = seq.sampling
+                if seq.fed < len(seq.prompt):
+                    seq.fed += 1
+                if not was_sampling and not seq.sampling:
+                    continue  # still prefilling; output not sampled yet
+                h = seq.handle
+                tok = sample_logits(probs[i], seq.temperature, seq.top_k,
+                                    seq.rng, seq.top_p)
+                h.tokens.append(tok)
+                self._m_tokens.inc()
+                now = time.monotonic()
+                if h.t_first_token is None:
+                    h.t_first_token = now
+                    self._m_ttft.record(now - h.t_submit)
+                if (len(h.tokens) >= h.max_new_tokens
+                        or (seq.eos_id is not None and tok == seq.eos_id)):
+                    h._finish()
+                    self._m_latency.record(now - h.t_submit)
+                    self._slots[i] = None
+            # frozen-depth guard: a free slot's position must not keep
+            # advancing toward the cache cap while the slot idles
+            self._reset_idle_positions(
+                [i for i in range(self.n_slots) if self._slots[i] is None])

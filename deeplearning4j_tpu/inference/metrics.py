@@ -1,0 +1,236 @@
+"""Serving-side SLO metrics: counters, gauges, streaming latency histograms.
+
+No reference counterpart (the 0.4-era serving route had zero telemetry);
+modeled on the Prometheus client-library data model — monotonic counters,
+point-in-time gauges, and fixed-bucket histograms whose percentiles are
+estimated by linear interpolation inside the owning bucket (the same
+estimate `histogram_quantile()` computes server-side).
+
+Lock discipline: one small lock per instrument, held only for a couple of
+scalar updates (`record` does no allocation on the hot path). Python's GIL
+already serializes the increments; the locks exist so `snapshot()` never
+reads a torn (count, sum) pair and so the module stays correct on GIL-free
+builds.
+
+Everything is wired through a :class:`MetricsRegistry` so the serving stack
+(`serving/server.py` `GET /metrics`), the UI snapshot poster
+(`ui/listeners.post_serving_metrics`) and the bench harness all read ONE
+source of truth.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Counter:
+    """Monotonic event counter (requests served, tokens emitted, ...)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value (queue depth, active slots, ...). Also tracks the
+    high-water mark — saturation shows up even between scrapes."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._max = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+            if v > self._max:
+                self._max = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    @property
+    def max(self) -> float:
+        return self._max
+
+
+def _log_buckets(lo: float, hi: float, per_decade: int) -> List[float]:
+    """Log-spaced bucket upper bounds covering [lo, hi]."""
+    n = int(math.ceil(math.log10(hi / lo) * per_decade))
+    return [lo * 10 ** (i / per_decade) for i in range(n + 1)]
+
+
+class Histogram:
+    """Streaming histogram over fixed log-spaced buckets.
+
+    O(1) per `record` (binary search over ~40 static bounds), O(buckets)
+    per percentile query — no reservoir, no per-sample storage, so a
+    million-request day costs the same memory as an idle server. Default
+    bounds cover 10 microseconds .. 100 seconds, the full range a serving
+    latency can plausibly land in.
+    """
+
+    def __init__(self, name: str, lo: float = 1e-5, hi: float = 100.0,
+                 per_decade: int = 6):
+        self.name = name
+        self._bounds = _log_buckets(lo, hi, per_decade)
+        self._counts = [0] * (len(self._bounds) + 1)  # + overflow bucket
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def record(self, v: float) -> None:
+        v = float(v)
+        lo, hi = 0, len(self._bounds)
+        while lo < hi:  # first bound >= v (bisect_left on static bounds)
+            mid = (lo + hi) // 2
+            if self._bounds[mid] < v:
+                lo = mid + 1
+            else:
+                hi = mid
+        with self._lock:
+            self._counts[lo] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1]): walk to the owning bucket,
+        interpolate linearly inside it, clamp to the observed min/max."""
+        with self._lock:
+            total = self._count
+            if not total:
+                return 0.0
+            counts = list(self._counts)
+            vmin, vmax = self._min, self._max
+        target = q * total
+        seen = 0
+        for i, c in enumerate(counts):
+            if seen + c >= target and c:
+                lo = self._bounds[i - 1] if i else 0.0
+                hi = self._bounds[i] if i < len(self._bounds) else vmax
+                frac = (target - seen) / c
+                est = lo + (hi - lo) * frac
+                return min(max(est, vmin), vmax)
+            seen += c
+        return vmax
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self._count, self._sum
+        if not count:
+            return {"count": 0}
+        return {
+            "count": count,
+            "sum": round(total, 6),
+            "mean": round(total / count, 6),
+            "min": round(self._min, 6),
+            "max": round(self._max, 6),
+            "p50": round(self.percentile(0.50), 6),
+            "p95": round(self.percentile(0.95), 6),
+            "p99": round(self.percentile(0.99), 6),
+        }
+
+
+class MetricsRegistry:
+    """Named instrument registry; `get_or_create` semantics so call sites
+    never race on registration."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._t0 = time.monotonic()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = Counter(name)
+            return self._counters[name]
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name not in self._gauges:
+                self._gauges[name] = Gauge(name)
+            return self._gauges[name]
+
+    def histogram(self, name: str, **kw) -> Histogram:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = Histogram(name, **kw)
+            return self._histograms[name]
+
+    def snapshot(self) -> dict:
+        """One JSON-able view of everything — the `GET /metrics` body and
+        the UI snapshot payload."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "uptime_sec": round(time.monotonic() - self._t0, 3),
+            "counters": {n: c.value for n, c in sorted(counters.items())},
+            "gauges": {n: {"value": g.value, "max": g.max}
+                       for n, g in sorted(gauges.items())},
+            "histograms": {n: h.snapshot()
+                           for n, h in sorted(histograms.items())},
+        }
+
+    def render_text(self) -> str:
+        """Prometheus-flavored text exposition (`/metrics?format=text`)."""
+        snap = self.snapshot()
+        lines = []
+        for n, v in snap["counters"].items():
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {v}")
+        for n, g in snap["gauges"].items():
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {g['value']}")
+        for n, h in snap["histograms"].items():
+            lines.append(f"# TYPE {n} summary")
+            if h.get("count"):
+                for q in ("p50", "p95", "p99"):
+                    lines.append(f'{n}{{quantile="{q[1:]}"}} {h[q]}')
+                lines.append(f"{n}_sum {h['sum']}")
+            lines.append(f"{n}_count {h.get('count', 0)}")
+        return "\n".join(lines) + "\n"
+
+
+_default: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    """Process-wide registry for components not handed an explicit one."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricsRegistry()
+        return _default

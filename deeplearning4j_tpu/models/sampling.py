@@ -39,6 +39,13 @@ def _sample_logits(probs: np.ndarray, temperature: float, top_k: Optional[int],
     return int(rng.choice(p.shape[-1], p=p))
 
 
+# public SPI: the serving decode scheduler (inference/engine.py) selects
+# tokens through the SAME function the solo generators use, which is what
+# makes engine output token-identical to generate_transformer/generate_rnn
+# for a given seed — one sampling definition, two decode loops
+sample_logits = _sample_logits
+
+
 def generate_transformer(net, prompt_ids: Sequence[int], n_tokens: int,
                          vocab_size: int, *, temperature: float = 0.0,
                          top_k: Optional[int] = None,

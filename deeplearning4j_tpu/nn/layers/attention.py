@@ -104,14 +104,26 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         pairing (GPT-NeoX "rotate-half" convention: dim i pairs with
         i + Dh/2 — NOT the paper's interleaved (0,1),(2,3) pairing; weight
         converters must match). The rotation commutes with the KV cache —
-        cached keys are stored pre-rotated at their absolute position."""
+        cached keys are stored pre-rotated at their absolute position.
+        ``pos0`` may be a scalar (whole batch at one depth) or a [B] vector
+        (slot-based decode: each row at its own depth)."""
         B, T, H, Dh = a.shape
         if Dh % 2:
             raise ValueError(f"rope requires an even head dim, got {Dh}")
         half = Dh // 2
         freq = jnp.asarray(self.conf.rope_base, jnp.float32) ** (
             -jnp.arange(half, dtype=jnp.float32) / half)
-        ang = (pos0 + jnp.arange(T, dtype=jnp.float32))[:, None] * freq[None]
+        pos = jnp.asarray(pos0)
+        t = jnp.arange(T, dtype=jnp.float32)
+        if pos.ndim:  # per-row positions -> per-row angles [B, T, half]
+            ang = (pos.astype(jnp.float32)[:, None]
+                   + t[None, :])[:, :, None] * freq[None, None]
+            cos = jnp.cos(ang)[:, :, None, :].astype(a.dtype)
+            sin = jnp.sin(ang)[:, :, None, :].astype(a.dtype)
+            a1, a2 = a[..., :half], a[..., half:]
+            return jnp.concatenate([a1 * cos - a2 * sin,
+                                    a1 * sin + a2 * cos], axis=-1)
+        ang = (pos + t)[:, None] * freq[None]
         cos = jnp.cos(ang)[None, :, None, :].astype(a.dtype)
         sin = jnp.sin(ang)[None, :, None, :].astype(a.dtype)
         a1, a2 = a[..., :half], a[..., half:]
@@ -145,16 +157,24 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         """Dense attention with q grouped over compact KV heads — THE single
         contraction for both the full forward (qpos0=0, L==T) and the
         KV-cached decode step (qpos0=cache position, L=cache capacity).
-        q: [B, T, H, Dh]; k, v: [B, L, Hkv, Dh] -> [B, T, H, Dh]."""
+        q: [B, T, H, Dh]; k, v: [B, L, Hkv, Dh] -> [B, T, H, Dh].
+        ``qpos0`` scalar, or [B] for per-row decode depths (slot scheduling:
+        each row's causal horizon is its own cache position)."""
         B, T, H, Dh = q.shape
         L, Hkv = k.shape[1], k.shape[2]
         qg = q.reshape(B, T, Hkv, H // Hkv, Dh)
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) / jnp.sqrt(
             jnp.asarray(Dh, q.dtype))
         if causal:
-            valid = (jnp.arange(L)[None, :]
-                     <= qpos0 + jnp.arange(T)[:, None])
-            s = jnp.where(valid[None, None, None], s.astype(jnp.float32),
+            qp = jnp.asarray(qpos0)
+            if qp.ndim:  # [B] -> valid [B, T, L] -> [B, 1, 1, T, L]
+                valid = (jnp.arange(L)[None, None, :]
+                         <= qp[:, None, None] + jnp.arange(T)[None, :, None])
+                valid = valid[:, None, None]
+            else:
+                valid = (jnp.arange(L)[None, :]
+                         <= qp + jnp.arange(T)[:, None])[None, None, None]
+            s = jnp.where(valid, s.astype(jnp.float32),
                           jnp.finfo(jnp.float32).min)
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
         return jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(B, T, H, Dh)
@@ -177,25 +197,38 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         B, T, _ = x.shape
         pos = state0["pos"]
         L_cap = state0["k"].shape[1]
+        per_slot = jnp.ndim(pos) > 0  # [B] positions: slot-based decode
         del rng  # no dropout on the inference step path
-        if not isinstance(pos, jax.core.Tracer) and int(pos) + T > L_cap:
+        if not isinstance(pos, jax.core.Tracer) and \
+                int(jnp.max(pos) if per_slot else pos) + T > L_cap:
             raise ValueError(
-                f"KV cache overflow: position {int(pos)}+{T} exceeds "
+                f"KV cache overflow: position "
+                f"{int(jnp.max(pos) if per_slot else pos)}+{T} exceeds "
                 f"max_cache_len={L_cap}; raise SelfAttentionLayer."
                 f"max_cache_len or rnn_clear_previous_state()")
         # under a trace pos is abstract and cannot raise; poison the output
         # with NaN instead of silently reading a clamp-corrupted cache
         overflow = (pos + T) > L_cap
         q, k_new, v_new = self._qkv(params, x, pos0=pos)
-        kc = jax.lax.dynamic_update_slice(state0["k"], k_new, (0, pos, 0, 0))
-        vc = jax.lax.dynamic_update_slice(state0["v"], v_new, (0, pos, 0, 0))
+        if per_slot:
+            # per-row write offsets: vmap the slice update over the batch
+            upd = jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
+                c, n, (p, 0, 0)))
+            kc = upd(state0["k"], k_new, pos)
+            vc = upd(state0["v"], v_new, pos)
+        else:
+            kc = jax.lax.dynamic_update_slice(state0["k"], k_new,
+                                              (0, pos, 0, 0))
+            vc = jax.lax.dynamic_update_slice(state0["v"], v_new,
+                                              (0, pos, 0, 0))
         # grouped contraction against the COMPACT cache: never materialize
         # the H-expanded K/V copies GQA exists to avoid
         o = self._grouped_attention(q, kc, vc, causal=True, qpos0=pos)
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
         y = self._out(params, o, B, T)
-        y = jnp.where(overflow, jnp.asarray(jnp.nan, y.dtype), y)
+        ovf = overflow[:, None, None] if per_slot else overflow
+        y = jnp.where(ovf, jnp.asarray(jnp.nan, y.dtype), y)
         # freeze the state on overflow (ADVICE r3): pos sticks at the
         # L_cap+1 sentinel so every LATER step also sees overflow and keeps
         # poisoning its output — the clamp-corrupted cache can never be

@@ -740,6 +740,8 @@ class MultiLayerNetwork:
             base_lr = getattr(updater, "learning_rate", -1.0)
             if base_lr is None or base_lr < 0:
                 base_lr = layer_conf.learning_rate
+            wd = float(getattr(updater, "weight_decay", 0.0) or 0.0)
+            wkeys = impl.WEIGHT_KEYS
             new_p, new_u = {}, {}
             for name, g in grads.items():
                 lr = effective_lr(base_lr, step, gconf.lr_policy,
@@ -747,7 +749,13 @@ class MultiLayerNetwork:
                                   gconf.lr_policy_steps, gconf.max_num_iterations,
                                   gconf.lr_schedule).astype(g.dtype)
                 delta, ns = updater.apply(ustate_i[name], g, lr, step)
-                new_p[name] = params_i[name] + delta
+                p = params_i[name]
+                if wd and name in wkeys:
+                    # same decoupled (AdamW-style) decay _apply_updaters
+                    # uses — pretraining must not silently drop the decay
+                    # that fine-tuning will apply (ADVICE r5 #4)
+                    delta = delta - lr * jnp.asarray(wd, p.dtype) * p
+                new_p[name] = p + delta
                 new_u[name] = ns
             return new_p, new_u
 

@@ -178,28 +178,36 @@ class QuantizedNetwork:
                    for p in jax.tree_util.tree_leaves(self._net.params))
 
     # -- forward -----------------------------------------------------------
-    def _run(self, params, variables, x):
+    def _run(self, params, variables, x, fmask=None):
         def qstep(si, st, cur):
             Wq, sw, b, sx = self._consts[si]
             return _int8_forward(st.kind, Wq, sw, b, sx, st.conv_args,
                                  st.activation, self._act_dtype, cur)
 
         return _walk_plan(self._net, self._steps, params, variables, x,
-                          self._act_dtype, qstep)
+                          self._act_dtype, qstep, fmask=fmask)
 
-    def output(self, x) -> Array:
+    def output(self, x, fmask=None) -> Array:
         if self._jitted is None:
             self._jitted = jax.jit(self._run)
-        return self._jitted(self._net.params, self._net.variables, x)
+        return self._jitted(self._net.params, self._net.variables,
+                            jnp.asarray(x),
+                            jnp.asarray(fmask) if fmask is not None else None)
 
     def predict(self, x) -> np.ndarray:
         return np.asarray(jnp.argmax(self.output(x), axis=-1))
 
     def evaluate(self, iterator, top_n: int = 1):
+        """Mirrors MultiLayerNetwork.evaluate's mask contract (ADVICE r5
+        #1): features_mask rides the plan walk, labels_mask weights the
+        eval — masked time-series evals match the float facade."""
         from ..evaluation.evaluation import Evaluation
         ev = Evaluation(top_n=top_n)
         for ds in iterator:
-            ev.eval(np.asarray(ds.labels), np.asarray(self.output(ds.features)))
+            out = self.output(ds.features,
+                              fmask=getattr(ds, "features_mask", None))
+            ev.eval(np.asarray(ds.labels), np.asarray(out),
+                    mask=getattr(ds, "labels_mask", None))
         return ev
 
 
@@ -245,11 +253,14 @@ def _build_steps(net, fold_bn: bool) -> List[_QStep]:
     return steps
 
 
-def _walk_plan(net, steps, params, variables, x, act_dtype, qstep_fn):
+def _walk_plan(net, steps, params, variables, x, act_dtype, qstep_fn,
+               fmask=None):
     """THE plan walk, shared by calibration and quantized inference so the
     two can't drift: input adaptation, per-step preprocessor dispatch,
     timestep tracking, float-fallback layers via the LayerImpl SPI — with
-    ``qstep_fn(si, step, cur)`` supplying the body of each quantized step."""
+    ``qstep_fn(si, step, cur)`` supplying the body of each quantized step.
+    ``fmask`` follows MultiLayerNetwork._forward_impl's discipline: handed
+    to every step whose input is 3D (time axis alive), dropped otherwise."""
     conf = net.conf
     cur = net._adapt_input(jnp.asarray(x))
     if jnp.issubdtype(cur.dtype, jnp.floating):
@@ -265,6 +276,7 @@ def _walk_plan(net, steps, params, variables, x, act_dtype, qstep_fn):
                 cur = proc.preprocess(cur)
         if cur.ndim == 3:
             timesteps = cur.shape[1]
+        lmask_arg = fmask if cur.ndim == 3 else None
         if st.kind == "float":
             # mirror MultiLayerNetwork._forward_impl's compute-dtype
             # discipline: params cast to the activation dtype for the math,
@@ -275,11 +287,16 @@ def _walk_plan(net, steps, params, variables, x, act_dtype, qstep_fn):
                    for a in jax.tree_util.tree_leaves(p)):
                 p = _cast_floats(p, act_dtype)
             cur, _ = st.impl.forward(p, cur, train=False,
-                                     variables=variables[st.index])
+                                     variables=variables[st.index],
+                                     mask=lmask_arg)
             if jnp.issubdtype(cur.dtype, jnp.floating) and cur.dtype != act_dtype:
                 cur = cur.astype(act_dtype)
         else:
             cur = qstep_fn(si, st, cur)
+            if lmask_arg is not None and cur.ndim == 3:
+                # the int8 kernel bypasses the impl's own mask application
+                # (DenseLayerImpl.forward_with_preout): re-apply it here
+                cur = cur * lmask_arg[..., None].astype(cur.dtype)
     return cur
 
 

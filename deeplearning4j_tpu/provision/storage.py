@@ -200,12 +200,21 @@ def sync_up(store: ObjectStore, local_dir, prefix: str = "") -> List[str]:
 
 def sync_down(store: ObjectStore, prefix: str, local_dir) -> List[str]:
     """Incremental download: objects whose local copy already matches the
-    store manifest's digest are skipped. Returns downloaded keys."""
+    store manifest's digest are skipped. Returns downloaded keys.
+
+    A manifest entry whose object has meanwhile been deleted from the
+    store (stale manifest — e.g. a foreign writer pruned shards without
+    rewriting `_manifest.json`) degrades to a PARTIAL sync: the missing
+    key is skipped, everything else still lands (ADVICE r5 #2 — manifest
+    problems recover, they never crash). A get failure for a key the
+    store still LISTS is a real transfer failure (network/auth/timeout)
+    and re-raises — swallowing it would report a silent empty sync."""
     local_dir = Path(local_dir)
     local_dir.mkdir(parents=True, exist_ok=True)
     prefix = prefix.strip("/")
     manifest = _load_manifest(store, prefix)
     fetched = []
+    listed = None  # lazy: one store.list, only on the first get failure
     if manifest:
         keys = list(manifest)
     else:  # no manifest (foreign writer): fall back to listing
@@ -217,7 +226,15 @@ def sync_down(store: ObjectStore, prefix: str, local_dir) -> List[str]:
         want = manifest.get(rel)
         if want and dst.is_file() and _sha256(dst) == want:
             continue
-        store.get(f"{prefix}/{rel}" if prefix else rel, dst)
+        full = f"{prefix}/{rel}" if prefix else rel
+        try:
+            store.get(full, dst)
+        except ProvisionError:
+            if listed is None:
+                listed = set(store.list(prefix))
+            if full in listed:
+                raise  # object exists: transfer failure, not staleness
+            continue  # stale manifest entry: partial sync, not a crash
         fetched.append(rel)
     return fetched
 
@@ -249,11 +266,22 @@ class StoreDataSetIterator:
         self._pos = 0
 
     def _local(self, key: str) -> Path:
-        return self._cache_dir / key.replace("/", "__")
+        # preserve the key's directory structure under the cache dir —
+        # a separator-flattening scheme ('/' -> '__') collides for keys
+        # like 'a/b.npz' vs 'a__b.npz' and can silently serve one shard's
+        # data as another's (ADVICE r5 #3). Containment check: a foreign
+        # store could list '..'-ed or absolute keys, and fetch/evict must
+        # never touch paths outside the cache dir.
+        root = self._cache_dir.resolve()
+        p = (root / key).resolve()
+        if root not in p.parents:
+            raise ProvisionError(f"shard key escapes the cache dir: {key}")
+        return p
 
     def _fetch(self, key: str) -> Path:
         local = self._local(key)
         if not local.is_file():
+            local.parent.mkdir(parents=True, exist_ok=True)
             self.store.get(key, local)
             self._cached.append(key)
             while len(self._cached) > self.cache_shards:

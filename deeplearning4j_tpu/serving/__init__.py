@@ -1,10 +1,13 @@
+from ..inference import (DecodeScheduler, MetricsRegistry, MicroBatcher,
+                         QueueFullError, RequestTimeoutError)
 from .durable import (DurableLogConsumer, DurableLogProducer,
                       DurableStreamingTrainer)
 from .server import InferenceServer
 from .streaming import (QueueDataSetIterator, RecordToDataSetConverter,
                         ServeRoute, StreamingTrainingPipeline)
 
-__all__ = ["DurableLogConsumer", "DurableLogProducer",
-           "DurableStreamingTrainer", "InferenceServer",
-           "QueueDataSetIterator", "RecordToDataSetConverter", "ServeRoute",
+__all__ = ["DecodeScheduler", "DurableLogConsumer", "DurableLogProducer",
+           "DurableStreamingTrainer", "InferenceServer", "MetricsRegistry",
+           "MicroBatcher", "QueueDataSetIterator", "QueueFullError",
+           "RecordToDataSetConverter", "RequestTimeoutError", "ServeRoute",
            "StreamingTrainingPipeline"]

@@ -6,10 +6,24 @@ model, vectorize incoming records, emit predictions) — exposed over HTTP
 (stdlib ThreadingHTTPServer, same stack as ui/server.py) instead of a
 Camel/Kafka route; see streaming.py for the queue-fed variant.
 
+Concurrency model (the TensorFlow-Serving batched-session shape,
+arXiv 1605.08695): by default every request is routed through a
+`inference.MicroBatcher` — concurrent clients' rows are aggregated into ONE
+padded bucketed device batch by a single dispatcher thread, so the model
+needs no lock and XLA compiles once per bucket. `batching=False` restores
+the original lock-serialized direct path (also the fallback for callers
+that need strict FIFO with zero batching delay). SLO telemetry (queue
+depth, batch occupancy, time-in-queue, latency percentiles, timeout/reject
+counts) lives in a `MetricsRegistry` exported at `GET /metrics`.
+
 Endpoints:
   GET  /health            {"status": "ok", "model": "...", "params": N}
   GET  /info              model summary + config JSON
+  GET  /metrics           SLO metrics snapshot (?format=text for a
+                          Prometheus-flavored exposition)
   POST /predict           {"data": [[...], ...]}  -> probabilities + argmax
+                          (?timeout_ms=N sets the request deadline; an
+                          expired request gets HTTP 504, a full queue 503)
   POST /predict/csv       text/plain CSV rows     -> same, via the
                           RecordToDataSetConverter (label column ignored)
 """
@@ -19,17 +33,24 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
-from typing import Optional, Union
+from typing import Dict, Optional, Tuple, Union
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ..inference import (MetricsRegistry, MicroBatcher, QueueFullError,
+                         RequestTimeoutError)
 from .streaming import RecordToDataSetConverter
 
 
 class InferenceServer:
     def __init__(self, net=None, model_path: Union[str, Path, None] = None,
                  port: int = 0, max_batch: int = 1024,
-                 converter: Optional[RecordToDataSetConverter] = None):
+                 converter: Optional[RecordToDataSetConverter] = None,
+                 batching: bool = True, batch_window_ms: float = 2.0,
+                 max_queue: int = 256,
+                 default_timeout_ms: Optional[float] = None,
+                 metrics: Optional[MetricsRegistry] = None):
         if net is None:
             if model_path is None:
                 raise ValueError("pass a net or a model_path")
@@ -38,22 +59,65 @@ class InferenceServer:
         self.net = net
         self.max_batch = max_batch
         self.converter = converter or RecordToDataSetConverter(label_index=None)
+        self.batching = batching
+        self.batch_window_ms = float(batch_window_ms)
+        self.max_queue = int(max_queue)
+        self.default_timeout_ms = default_timeout_ms
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._port = port
-        self._lock = threading.Lock()  # output() mutates net._jit_cache etc.
+        self._lock = threading.Lock()  # unbatched path: output() mutates
+        # net._jit_cache etc.
+        # one batcher per trailing feature signature (each signature is its
+        # own family of bucketed XLA programs). Bounded: a client free-form
+        # controls the signature via the payload, and each batcher costs a
+        # dispatcher thread + compiled programs — beyond the cap, unseen
+        # signatures take the lock-serialized path instead of allocating.
+        self._batchers: Dict[Tuple, MicroBatcher] = {}
+        self._batchers_lock = threading.Lock()
+        self.max_signatures = 16
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1] if self._httpd else self._port
 
-    def _predict(self, arr: np.ndarray) -> dict:
+    def _batcher_for(self, arr: np.ndarray) -> Optional[MicroBatcher]:
+        sig = (arr.shape[1:], str(arr.dtype))
+        with self._batchers_lock:
+            b = self._batchers.get(sig)
+            if b is None:
+                if len(self._batchers) >= self.max_signatures:
+                    return None  # signature-cap overflow: direct path
+                b = MicroBatcher(
+                    lambda a: np.asarray(self.net.output(a)),
+                    max_batch=self.max_batch, max_queue=self.max_queue,
+                    batch_window_s=self.batch_window_ms / 1e3,
+                    metrics=self.metrics, name="predict").start()
+                self._batchers[sig] = b
+            return b
+
+    def _forward(self, arr: np.ndarray,
+                 timeout_ms: Optional[float]) -> np.ndarray:
+        if self.batching:
+            batcher = self._batcher_for(arr)
+            if batcher is not None:
+                timeout_s = (timeout_ms / 1e3 if timeout_ms is not None
+                             else None)
+                return batcher.predict(arr, timeout_s=timeout_s)
         outs = []
         with self._lock:
             for off in range(0, arr.shape[0], self.max_batch):
                 outs.append(np.asarray(
                     self.net.output(arr[off:off + self.max_batch])))
-        out = np.concatenate(outs) if outs else np.zeros((0, 0), np.float32)
+        return np.concatenate(outs) if outs else np.zeros((0, 0), np.float32)
+
+    def _predict(self, arr: np.ndarray,
+                 timeout_ms: Optional[float] = None) -> dict:
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        out = (self._forward(arr, timeout_ms) if arr.shape[0]
+               else np.zeros((0, 0), np.float32))
         return {
             "predictions": out.astype(float).tolist(),
             "classes": np.argmax(out, axis=-1).astype(int).tolist()
@@ -62,47 +126,79 @@ class InferenceServer:
 
     def start(self) -> "InferenceServer":
         server = self
+        m_http = self.metrics.counter("http_requests_total")
+        m_err = self.metrics.counter("http_errors_total")
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # quiet
                 pass
 
-            def _send(self, obj, code=200):
-                body = json.dumps(obj).encode()
+            def _send(self, obj, code=200, content_type="application/json"):
+                body = (obj if isinstance(obj, bytes)
+                        else json.dumps(obj).encode())
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path.startswith("/health"):
+                m_http.inc()
+                url = urlparse(self.path)
+                if url.path == "/health":
                     self._send({"status": "ok",
                                 "model": type(server.net).__name__,
                                 "params": server.net.num_params()})
-                elif self.path.startswith("/info"):
+                elif url.path == "/info":
                     self._send({"model": type(server.net).__name__,
                                 "config": json.loads(server.net.conf.to_json()),
-                                "params": server.net.num_params()})
+                                "params": server.net.num_params(),
+                                "batching": server.batching})
+                elif url.path == "/metrics":
+                    q = parse_qs(url.query)
+                    if q.get("format", [""])[0] == "text":
+                        self._send(server.metrics.render_text().encode(),
+                                   content_type="text/plain; version=0.0.4")
+                    else:
+                        self._send(server.metrics.snapshot())
                 else:
                     self._send({"error": "not found"}, 404)
 
             def do_POST(self):
+                m_http.inc()
+                url = urlparse(self.path)
+                q = parse_qs(url.query)
+                timeout_ms = None
+                if "timeout_ms" in q:
+                    try:
+                        timeout_ms = float(q["timeout_ms"][0])
+                    except ValueError:
+                        m_err.inc()
+                        return self._send(
+                            {"error": "timeout_ms must be a number"}, 400)
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n)
                 try:
-                    if self.path.startswith("/predict/csv"):
+                    if url.path == "/predict/csv":
                         rows = [line.split(",") for line in
                                 raw.decode().strip().splitlines() if line.strip()]
                         ds = server.converter.convert(rows)
-                        self._send(server._predict(np.asarray(ds.features)))
-                    elif self.path.startswith("/predict"):
+                        self._send(server._predict(np.asarray(ds.features),
+                                                   timeout_ms))
+                    elif url.path == "/predict":
                         payload = json.loads(raw.decode())
                         arr = np.asarray(payload["data"], np.float32)
-                        self._send(server._predict(arr))
+                        self._send(server._predict(arr, timeout_ms))
                     else:
                         self._send({"error": "not found"}, 404)
+                except RequestTimeoutError as e:
+                    m_err.inc()
+                    self._send({"error": f"deadline exceeded: {e}"}, 504)
+                except QueueFullError as e:
+                    m_err.inc()
+                    self._send({"error": f"over capacity: {e}"}, 503)
                 except Exception as e:  # bad payloads must not kill the server
+                    m_err.inc()
                     self._send({"error": str(e)}, 400)
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
@@ -116,3 +212,8 @@ class InferenceServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        with self._batchers_lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.stop()

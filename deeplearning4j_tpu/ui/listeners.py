@@ -206,6 +206,19 @@ def post_tsne(server_url: str, coords, labels=None,
            "labels": list(labels) if labels is not None else []})
 
 
+def post_serving_metrics(server_url: str, metrics,
+                         session_id: str = "default") -> None:
+    """Upload a serving SLO metrics snapshot for the /serving view.
+
+    ``metrics``: an `inference.MetricsRegistry` (snapshotted here) or an
+    already-built snapshot dict — so both a live `InferenceServer`
+    (`post_serving_metrics(url, srv.metrics)`) and an offline recorder can
+    feed the page. Same transport as every other listener in this module."""
+    snap = metrics.snapshot() if hasattr(metrics, "snapshot") else dict(metrics)
+    _post(f"{server_url.rstrip('/')}/serving/update?sid={session_id}",
+          {"metrics": snap})
+
+
 def post_word_vectors(server_url: str, word_vectors,
                       session_id: str = "default") -> None:
     """Index a fitted embedding model (Word2Vec/SequenceVectors) for the

@@ -32,7 +32,8 @@ _DASHBOARD = """<!DOCTYPE html>
 <p><a href="/weights">weights</a> | <a href="/activations">activations</a> |
 <a href="/filters">filters</a> |
 <a href="/flow">flow</a> | <a href="/tsne">t-SNE view</a> |
-<a href="/nearestneighbors">nearest neighbors</a></p>
+<a href="/nearestneighbors">nearest neighbors</a> |
+<a href="/serving">serving</a></p>
 <div id="sessions"></div>
 <canvas id="chart" width="900" height="320" style="border:1px solid #ccc"></canvas>
 <script>
@@ -274,6 +275,34 @@ async function draw() {
 draw(); setInterval(draw, 5000);
 </script></body></html>"""
 
+_SERVING_PAGE = """<!DOCTYPE html>
+<html><head><title>Serving metrics</title></head>
+<body style="font-family:sans-serif">
+<h2>Serving SLO metrics</h2>
+<div id="meta"></div>
+<table id="t" border="1" cellpadding="4" style="border-collapse:collapse">
+</table>
+<script>
+async function refresh() {
+  const d = await (await fetch('/serving/data' + location.search)).json();
+  const m = d.metrics || {};
+  document.getElementById('meta').innerText =
+    'uptime: ' + (m.uptime_sec || 0) + 's';
+  let rows = '<tr><th>metric</th><th>value</th></tr>';
+  for (const [k, v] of Object.entries(m.counters || {}))
+    rows += '<tr><td>' + k + '</td><td>' + v + '</td></tr>';
+  for (const [k, v] of Object.entries(m.gauges || {}))
+    rows += '<tr><td>' + k + '</td><td>' + v.value +
+            ' (max ' + v.max + ')</td></tr>';
+  for (const [k, h] of Object.entries(m.histograms || {}))
+    rows += '<tr><td>' + k + '</td><td>n=' + (h.count || 0) +
+            (h.count ? ' p50=' + h.p50 + ' p95=' + h.p95 +
+                       ' p99=' + h.p99 : '') + '</td></tr>';
+  document.getElementById('t').innerHTML = rows;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
 _NN_PAGE = """<!DOCTYPE html>
 <html><head><title>Nearest neighbors</title></head>
 <body style="font-family:sans-serif">
@@ -311,6 +340,7 @@ class UiServer:
         self.tsne = SessionStorage()
         self.activations = SessionStorage()
         self.filters = SessionStorage()
+        self.serving = SessionStorage()
         self._nn_trees = {}
         server = self
 
@@ -376,6 +406,11 @@ class UiServer:
                 if url.path == "/tsne/data":
                     return self._json(server.tsne.get(sid, "coords")
                                       or {"coords": [], "labels": []})
+                if url.path == "/serving":
+                    return self._html(_SERVING_PAGE)
+                if url.path == "/serving/data":
+                    return self._json(server.serving.get(sid, "latest")
+                                      or {})
                 if url.path == "/nearestneighbors":
                     return self._html(_NN_PAGE)
                 if url.path == "/nearestneighbors/search":
@@ -410,6 +445,9 @@ class UiServer:
                     server.tsne.put(sid, "coords",
                                     {"coords": payload.get("coords", []),
                                      "labels": payload.get("labels", [])})
+                    return self._json({"status": "ok"})
+                if url.path == "/serving/update":
+                    server.serving.put(sid, "latest", payload)
                     return self._json({"status": "ok"})
                 if url.path == "/nearestneighbors/update":
                     server._nn_index(sid, payload.get("labels", []),

@@ -1,0 +1,112 @@
+"""Load-generate against the batched inference server (ISSUE 1).
+
+Starts an `InferenceServer` (continuous micro-batching ON), drives it with
+N closed-loop HTTP client threads, then prints the SLO picture straight
+from `GET /metrics`: requests/sec, mean batch occupancy, queue depth
+high-water mark, and p50/p95/p99 end-to-end latency. Run `--compare` to
+also measure the lock-serialized fallback on the same model (the
+pre-batching serving path) and print the speedup.
+
+    python examples/serving_load_test.py            # batched only
+    python examples/serving_load_test.py --compare  # batched vs serialized
+"""
+import argparse
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import InferenceServer
+
+
+def _make_net(n_in=64, hidden=256, n_out=10):
+    b = NeuralNetConfiguration.builder().seed(1).learning_rate(0.01).list()
+    b.layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+    b.layer(DenseLayer(n_in=hidden, n_out=hidden, activation="relu"))
+    b.layer(OutputLayer(n_in=hidden, n_out=n_out, activation="softmax",
+                        loss="mcxent"))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=body,
+                                 headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+def _drive(server, n_threads, reqs_each, body):
+    _post(server.port, "/predict", body)  # warm the jitted buckets
+    errors = []
+    t0 = time.perf_counter()
+
+    def client():
+        for _ in range(reqs_each):
+            try:
+                _post(server.port, "/predict", body)
+            except Exception as e:  # keep driving; report at the end
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    return n_threads * reqs_each / elapsed, errors
+
+
+def main(n_threads=8, reqs_each=10, rows=8, compare=False, verbose=True):
+    net = _make_net()
+    rng = np.random.default_rng(0)
+    body = json.dumps(
+        {"data": rng.standard_normal((rows, 64)).tolist()}).encode()
+
+    srv = InferenceServer(net=net, batching=True, batch_window_ms=1.0,
+                          max_batch=64).start()
+    try:
+        rps, errors = _drive(srv, n_threads, reqs_each, body)
+        metrics = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics").read())
+    finally:
+        srv.stop()
+    occ = metrics["histograms"]["predict_batch_occupancy"].get("mean", 0)
+    lat = metrics["histograms"]["predict_latency_sec"]
+    if verbose:
+        print(f"batched:    {rps:8.1f} req/s  "
+              f"(occupancy {occ:.2f}, queue-depth max "
+              f"{metrics['gauges']['predict_queue_depth']['max']:.0f}, "
+              f"errors {len(errors)})")
+        if lat.get("count"):
+            print(f"latency:    p50 {lat['p50'] * 1e3:.2f}ms  "
+                  f"p95 {lat['p95'] * 1e3:.2f}ms  "
+                  f"p99 {lat['p99'] * 1e3:.2f}ms")
+    if compare:
+        srv = InferenceServer(net=net, batching=False).start()
+        try:
+            serial_rps, _ = _drive(srv, n_threads, reqs_each, body)
+        finally:
+            srv.stop()
+        if verbose:
+            print(f"serialized: {serial_rps:8.1f} req/s  "
+                  f"-> batching speedup {rps / serial_rps:.2f}x")
+    assert not errors, errors
+    assert occ >= 1.0
+    return occ
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=10,
+                    help="requests per client thread")
+    ap.add_argument("--rows", type=int, default=8, help="rows per request")
+    ap.add_argument("--compare", action="store_true",
+                    help="also measure the lock-serialized fallback")
+    a = ap.parse_args()
+    main(n_threads=a.threads, reqs_each=a.requests, rows=a.rows,
+         compare=a.compare)

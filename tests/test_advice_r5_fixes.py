@@ -1,0 +1,188 @@
+"""Regression tests for the round-5 advisor findings (ADVICE.md r5).
+
+1. QuantizedNetwork.evaluate threads features_mask/labels_mask like the
+   float facade.
+2. sync_down degrades to a partial sync on a stale manifest entry.
+3. StoreDataSetIterator's local cache mapping is collision-free.
+4. Layerwise pretrain applies decoupled weight_decay like fine-tuning.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (AutoEncoder, DenseLayer,
+                                               OutputLayer, RnnOutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.quantization import quantize
+from deeplearning4j_tpu.nn.updater.updaters import Adam
+from deeplearning4j_tpu.provision.storage import (LocalObjectStore,
+                                                  StoreDataSetIterator,
+                                                  sync_down, sync_up)
+
+
+# ---------------------------------------------------- 1: masked quant eval --
+def _masked_ts_net():
+    b = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.05)
+         .list())
+    b.layer(DenseLayer(n_in=5, n_out=8, activation="relu"))
+    b.layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                           loss="mcxent"))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def _masked_ts_data(B=4, T=6):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((B, T, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (B, T))]
+    fmask = (rng.random((B, T)) > 0.3).astype(np.float32)
+    fmask[:, 0] = 1.0  # every series has at least one live step
+    return DataSet(x, y, features_mask=fmask, labels_mask=fmask.copy())
+
+
+def test_quantized_evaluate_threads_masks_like_float_facade():
+    net = _masked_ts_net()
+    ds = _masked_ts_data()
+    qnet = quantize(net, [ds.features], fold_bn=False)
+
+    ev_f = net.evaluate([ds])
+    ev_q = qnet.evaluate([ds])
+    masked_count = int(ds.labels_mask.sum())
+    # the labels_mask governs how many timesteps are COUNTED — identical
+    # to the float facade, and strictly fewer than the unmasked B*T
+    assert int(ev_f.confusion.matrix.sum()) == masked_count
+    assert int(ev_q.confusion.matrix.sum()) == masked_count
+    unmasked = qnet.evaluate([DataSet(ds.features, ds.labels)])
+    assert int(unmasked.confusion.matrix.sum()) == ds.labels.shape[0] * \
+        ds.labels.shape[1] > masked_count
+
+
+def test_quantized_output_respects_features_mask():
+    """features_mask zeroes masked timesteps mid-plan, so outputs at LIVE
+    positions are independent of masked positions' feature values — the
+    same invariant the float facade provides."""
+    net = _masked_ts_net()
+    ds = _masked_ts_data()
+    qnet = quantize(net, [ds.features], fold_bn=False)
+    base = np.asarray(qnet.output(ds.features, fmask=ds.features_mask))
+    poked = ds.features.copy()
+    poked[ds.features_mask == 0] = 1e3  # garbage in masked timesteps only
+    out = np.asarray(qnet.output(poked, fmask=ds.features_mask))
+    live = ds.features_mask > 0
+    np.testing.assert_allclose(out[live], base[live], rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------------------- 2: partial sync ---
+def test_sync_down_partial_on_stale_manifest(tmp_path):
+    store = LocalObjectStore(tmp_path / "store")
+    src = tmp_path / "src"
+    src.mkdir()
+    for name in ("a.bin", "b.bin", "c.bin"):
+        (src / name).write_bytes(name.encode() * 10)
+    assert sorted(sync_up(store, src, "data")) == ["a.bin", "b.bin", "c.bin"]
+
+    # a foreign writer deletes one object WITHOUT rewriting the manifest
+    (tmp_path / "store" / "data" / "b.bin").unlink()
+
+    dst = tmp_path / "dst"
+    fetched = sync_down(store, "data", dst)  # must not raise
+    assert sorted(fetched) == ["a.bin", "c.bin"]
+    assert (dst / "a.bin").read_bytes() == b"a.bin" * 10
+    assert not (dst / "b.bin").exists()
+
+
+def test_sync_down_reraises_real_transfer_failures(tmp_path):
+    """Only STALE manifest entries are skipped — a get failure for a key
+    the store still lists (network/auth/timeout) must surface, or a dead
+    credential would read as a successful empty sync."""
+    from deeplearning4j_tpu.provision.tpu_pods import ProvisionError
+    store = LocalObjectStore(tmp_path / "store")
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.bin").write_bytes(b"a" * 10)
+    sync_up(store, src, "data")
+
+    def broken_get(key, local):
+        raise ProvisionError("simulated transfer failure")
+
+    store.get = broken_get
+    with pytest.raises(ProvisionError, match="transfer failure"):
+        sync_down(store, "data", tmp_path / "dst")
+
+
+# --------------------------------------------- 3: collision-free cache -----
+def test_store_iterator_cache_keys_do_not_collide(tmp_path):
+    store = LocalObjectStore(tmp_path / "store")
+    shards = {"a/b.npz": 1.0, "a__b.npz": 2.0}  # the r5 collision pair
+    for key, val in shards.items():
+        p = tmp_path / "stage.npz"
+        np.savez(p, features=np.full((2, 3), val, np.float32),
+                 labels=np.eye(2, dtype=np.float32))
+        store.put(p, key)
+
+    it = StoreDataSetIterator(store, cache_shards=1,
+                              cache_dir=tmp_path / "cache")
+    seen = {}
+    for key, ds in zip(it.keys, it):
+        seen[key] = float(ds.features[0, 0])
+    # each shard must serve ITS OWN data (the flattened '__' mapping made
+    # the second fetch hit the first shard's cache file)
+    for key, val in shards.items():
+        assert seen[key] == val, f"{key} served another shard's data"
+    # a second pass re-fetches through the eviction path, still collision-free
+    for key, ds in zip(it.keys, it):
+        assert float(ds.features[0, 0]) == shards[key]
+
+
+def test_store_iterator_cache_key_cannot_escape_cache_dir(tmp_path):
+    """The structure-preserving mapping must stay contained: a foreign
+    store listing a '..'-ed key must not let fetch/evict touch paths
+    outside the cache dir."""
+    from deeplearning4j_tpu.provision.tpu_pods import ProvisionError
+    store = LocalObjectStore(tmp_path / "store")
+    p = tmp_path / "stage.npz"
+    np.savez(p, features=np.zeros((2, 3), np.float32),
+             labels=np.eye(2, dtype=np.float32))
+    store.put(p, "ok.npz")
+    it = StoreDataSetIterator(store, cache_dir=tmp_path / "cache")
+    with pytest.raises(ProvisionError, match="escapes"):
+        it._local("../../outside.npz")
+
+
+# ----------------------------------------- 4: pretrain weight decay --------
+def _ae_net(wd: float):
+    b = (NeuralNetConfiguration.builder().seed(11).learning_rate(0.05)
+         .updater(Adam(learning_rate=0.05, weight_decay=wd))
+         .list().pretrain(True))
+    b.layer(AutoEncoder(n_in=6, n_out=4, activation="sigmoid",
+                        corruption_level=0.0))
+    b.layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                        loss="mcxent"))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def test_pretrain_applies_decoupled_weight_decay():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((8, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    ds = DataSet(x, y)
+
+    net0 = _ae_net(0.0)
+    net1 = _ae_net(0.1)
+    W_init = np.asarray(net1.params[0]["W"]).copy()
+    np.testing.assert_array_equal(W_init, np.asarray(net0.params[0]["W"]))
+
+    net0.pretrain([ds])
+    net1.pretrain([ds])
+    W0 = np.asarray(net0.params[0]["W"])
+    W1 = np.asarray(net1.params[0]["W"])
+    # decoupled decay: one pretrain step differs by exactly -lr*wd*W_init
+    # (the Adam moments never see the decay term)
+    np.testing.assert_allclose(W1, W0 - 0.05 * 0.1 * W_init,
+                               rtol=1e-5, atol=1e-6)
+    # bias terms are NOT decayed (WEIGHT_KEYS restriction)
+    np.testing.assert_allclose(np.asarray(net1.params[0]["b"]),
+                               np.asarray(net0.params[0]["b"]),
+                               rtol=1e-6, atol=1e-7)
+    # and with wd=0 the fix is a no-op: both paths still converge the loss
+    assert np.isfinite(net0.score_) and np.isfinite(net1.score_)

@@ -34,6 +34,12 @@ def test_serving_example():
     assert model_serving.main() == 5
 
 
+def test_serving_load_test_example():
+    import serving_load_test
+    occ = serving_load_test.main(n_threads=4, reqs_each=4, verbose=False)
+    assert occ >= 1.0
+
+
 def test_deep_belief_net_example():
     import deep_belief_net
     acc = deep_belief_net.main(epochs=20, num_examples=256, batch=64)
