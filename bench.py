@@ -292,6 +292,71 @@ def bench_serving_throughput(n_threads=8, reqs_each=25, rows=8,
     }
 
 
+def bench_decode_prefill(prompt_len=256, new_tokens=16, chunk=64,
+                         vocab=64) -> dict:
+    """Chunked-prefill A/B on the decode scheduler (ISSUE 2 acceptance):
+    one long-prompt generation through the SAME transformer LM with (a)
+    token-by-token prefill (prefill_chunk=1, the pre-ISSUE-2 path: one
+    engine step per prompt token) and (b) chunked prefill (pow2-bucketed
+    multi-token prefill programs). Records TTFT in engine steps AND wall
+    time, total latency, and verifies the greedy outputs token-identical
+    to each other and to solo `generate_transformer(use_cache=True)`.
+    Standalone-runnable:
+        python -c "import bench, json; print(json.dumps(bench.bench_decode_prefill()))"
+    """
+    from deeplearning4j_tpu.inference import DecodeScheduler
+    from deeplearning4j_tpu.models.sampling import generate_transformer
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = transformer_lm(vocab_size=vocab, d_model=64, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = prompt_len + new_tokens
+    net = ComputationGraph(conf).init()
+    prompt = list(np.random.default_rng(7).integers(0, vocab, prompt_len))
+    solo = generate_transformer(net, prompt, new_tokens, vocab,
+                                use_cache=True)
+
+    def run(prefill_chunk):
+        eng = DecodeScheduler(net, vocab, n_slots=2,
+                              prefill_chunk=prefill_chunk).start()
+        try:
+            eng.submit(prompt, new_tokens).result(600)  # warm (compiles)
+            h = eng.submit(prompt, new_tokens)
+            toks = h.result(600)
+            return {
+                "tokens": toks,
+                "ttft_steps": h.steps_to_first_token,
+                "ttft_ms": round((h.t_first_token - h.t_submit) * 1e3, 2),
+                "total_ms": round((h.t_done - h.t_submit) * 1e3, 2),
+            }
+        finally:
+            eng.stop()
+
+    tbt = run(1)        # token-by-token: prompt_len steps to first token
+    chunked = run(chunk)
+    return {
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "prefill_chunk": chunk,
+        "ttft_steps_token_by_token": tbt["ttft_steps"],
+        "ttft_steps_chunked": chunked["ttft_steps"],
+        "ttft_ms_token_by_token": tbt["ttft_ms"],
+        "ttft_ms_chunked": chunked["ttft_ms"],
+        "ttft_speedup": round(tbt["ttft_ms"] / chunked["ttft_ms"], 2),
+        "total_ms_token_by_token": tbt["total_ms"],
+        "total_ms_chunked": chunked["total_ms"],
+        "outputs_identical": tbt["tokens"] == chunked["tokens"] == solo,
+        "note": f"{prompt_len}-token prompt + {new_tokens} greedy tokens, "
+                "2-block d64 transformer LM (RoPE), 2 decode slots; "
+                "chunked = one pow2-bucketed multi-token prefill program "
+                "per iteration, token-by-token = the pre-ISSUE-2 path",
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -769,6 +834,12 @@ def main() -> None:
         WORKLOADS["serving_throughput"] = bench_serving_throughput()
     except Exception as e:
         WORKLOADS["serving_throughput"] = {"error": str(e)}
+
+    # ---- serving: chunked-prefill TTFT A/B (ISSUE 2) --------------------
+    try:
+        WORKLOADS["decode_prefill"] = bench_decode_prefill()
+    except Exception as e:
+        WORKLOADS["decode_prefill"] = {"error": str(e)}
 
     # ---- perf-regression gate vs committed floors (BENCH_FLOORS.json) ----
     regressions = check_floors(WORKLOADS)

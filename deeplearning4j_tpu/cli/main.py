@@ -12,6 +12,8 @@ Usage:
   dl4j-tpu predict --model model.zip --input data.csv [--output preds.csv]
   dl4j-tpu serve   --model model.zip [--port P] [--int8] [--no-batching]
                    [--batch-window-ms MS] [--queue-size N] [--timeout-ms MS]
+                   [--generate [--vocab-size V] [--decode-slots N]
+                    [--prefill-chunk C]]
 """
 from __future__ import annotations
 
@@ -99,22 +101,51 @@ def cmd_serve(args) -> int:
               batching=not args.no_batching,
               batch_window_ms=args.batch_window_ms,
               max_queue=args.queue_size,
-              default_timeout_ms=args.timeout_ms)
+              default_timeout_ms=args.timeout_ms,
+              decode_slots=args.decode_slots,
+              prefill_chunk=args.prefill_chunk)
     if getattr(args, "int8", False):
         # artifact must carry calibration (nn/quantization.save_quantized);
         # weight quantization is rebuilt deterministically from the params
         from ..nn.quantization import load_quantized
-        server = InferenceServer(net=load_quantized(args.model), **kw).start()
+        net = load_quantized(args.model)
         mode = "int8"
     else:
-        server = InferenceServer(model_path=args.model, **kw).start()
+        # type-dispatching restore: --generate's primary target is a
+        # transformer LM ComputationGraph, not just MLN facades
+        from ..util.model_serializer import restore_model
+        net = restore_model(args.model)
         mode = "float"
+    if args.generate:
+        if mode == "int8":
+            # DecodeScheduler drives the float forward impls + KV cache;
+            # the quantized program has neither
+            print("error: --generate is not supported with --int8 "
+                  "(the decode scheduler needs the float model)",
+                  file=sys.stderr)
+            return 2
+        # the LM's next-token head width IS the vocabulary; --vocab-size
+        # only exists for models whose output layer is wider than the
+        # token space actually served
+        if args.vocab_size:
+            kw["decode_vocab"] = args.vocab_size
+        elif hasattr(net.conf, "vertices"):  # ComputationGraph facade
+            out = net.conf.network_outputs[0]
+            kw["decode_vocab"] = int(net.conf.vertices[out].layer.n_out)
+        else:
+            kw["decode_vocab"] = int(net.conf.layers[-1].n_out)
+    server = InferenceServer(net=net, **kw).start()
     batch_mode = ("lock-serialized" if args.no_batching else
                   f"micro-batched, window {args.batch_window_ms}ms, "
                   f"queue {args.queue_size}")
-    print(f"Serving {args.model} ({mode}, {batch_mode}) on "
+    gen_mode = (f"; /generate: {args.decode_slots} slots, "
+                f"prefill chunk {args.prefill_chunk}" if args.generate
+                else "")
+    print(f"Serving {args.model} ({mode}, {batch_mode}{gen_mode}) on "
           f"http://127.0.0.1:{server.port} "
-          "(POST /predict, /predict/csv; GET /health, /info, /metrics)")
+          "(POST /predict, /predict/csv"
+          + (", /generate" if args.generate else "")
+          + "; GET /health, /info, /metrics)")
     if args.once:  # test hook: start, report, stop
         server.stop()
         return 0
@@ -183,6 +214,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="default per-request deadline; expired requests "
                         "get HTTP 504 (clients can override per request "
                         "with ?timeout_ms=)")
+    s.add_argument("--generate", action="store_true",
+                   help="expose POST /generate backed by the continuous-"
+                        "batching decode scheduler (chunked prefill)")
+    s.add_argument("--vocab-size", type=int, default=None,
+                   help="LM vocabulary for /generate (default: inferred "
+                        "from the model's output layer width)")
+    s.add_argument("--decode-slots", type=int, default=4,
+                   help="concurrent decode slots for /generate")
+    s.add_argument("--prefill-chunk", type=int, default=64,
+                   help="max prompt tokens prefilled per engine step "
+                        "(pow2 chunk buckets; TTFT/decode-latency knob; "
+                        "<=1 = token-by-token prefill)")
     s.add_argument("--once", action="store_true",
                    help="start and immediately stop (smoke test)")
     s.set_defaults(func=cmd_serve)

@@ -162,23 +162,35 @@ class AsyncDataSetIterator(DataSetIterator):
         self._queue: "queue.Queue" = queue.Queue(self._size)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self._gen = 0  # worker generation token (see reset)
         self._start()
 
     def _start(self):
-        self._queue = queue.Queue(self._size)
+        # each worker belongs to ONE generation and only ever touches that
+        # generation's queue (captured locally): a worker that comes back
+        # from a blocking `next_batch` after reset() superseded it must
+        # not push stale batches into the successor's queue
+        self._gen += 1
+        gen = self._gen
+        q = queue.Queue(self._size)
+        self._queue = q
         self._error = None
         self._stop = False
 
         def worker():
             try:
-                while not self._stop:
+                while not self._stop and gen == self._gen:
                     ds = self._under.next_batch()
-                    self._queue.put(self._SENTINEL if ds is None else ds)
+                    if self._stop or gen != self._gen:
+                        return  # superseded DURING the blocking call:
+                        # drop the batch, never touch _under or q again
+                    q.put(self._SENTINEL if ds is None else ds)
                     if ds is None:
                         return
             except BaseException as e:  # surfaced on the consumer thread
-                self._error = e
-                self._queue.put(self._SENTINEL)
+                if gen == self._gen:
+                    self._error = e
+                    q.put(self._SENTINEL)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -187,17 +199,23 @@ class AsyncDataSetIterator(DataSetIterator):
         return self._under.batch_size()
 
     def reset(self) -> None:
-        if self._thread is not None and self._thread.is_alive():
-            # signal stop, then unblock a possibly-full queue; the worker
-            # exits at its next loop check instead of walking the whole
-            # underlying iterator to exhaustion
+        t = self._thread
+        if t is not None and t.is_alive():
+            # invalidate the worker's generation, then drain its queue so a
+            # blocked put() wakes, and join WITHOUT a deadline: `_under`
+            # must not be reset (or handed to a successor) while the old
+            # worker can still be inside `_under.next_batch()` — a timed
+            # join that gives up would leave two workers consuming the
+            # same underlying iterator (duplicated/dropped batches)
             self._stop = True
-            while self._thread.is_alive():
+            self._gen += 1
+            while t.is_alive():
                 try:
                     self._queue.get(timeout=0.01)
                 except queue.Empty:
                     pass
-            self._thread.join(timeout=1.0)
+                t.join(timeout=0.01)
+            t.join()  # deterministic: worker is out of _under for good
         self._under.reset()
         self._start()
 

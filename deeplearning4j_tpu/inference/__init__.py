@@ -8,11 +8,12 @@ over the attention KV cache; `MetricsRegistry` records queue depth, batch
 occupancy, and latency percentiles, exported at `GET /metrics`.
 """
 from .batcher import (InferenceFuture, MicroBatcher, QueueFullError,
-                      RequestTimeoutError)
+                      RequestTimeoutError, pow2_buckets)
 from .engine import DecodeHandle, DecodeScheduler
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry)
 
 __all__ = ["Counter", "DecodeHandle", "DecodeScheduler", "Gauge",
            "Histogram", "InferenceFuture", "MetricsRegistry", "MicroBatcher",
-           "QueueFullError", "RequestTimeoutError", "default_registry"]
+           "QueueFullError", "RequestTimeoutError", "default_registry",
+           "pow2_buckets"]

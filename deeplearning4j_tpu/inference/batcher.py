@@ -80,13 +80,20 @@ class _Request:
         self.t_enqueue = time.monotonic()
 
 
-def _pow2_buckets(max_batch: int) -> List[int]:
+def pow2_buckets(max_batch: int) -> List[int]:
+    """Ascending power-of-two sizes up to (and including) ``max_batch`` —
+    the compile-once-per-bucket shape set. Shared by the request collator
+    (batch-dimension buckets) and the decode scheduler's chunked prefill
+    (prompt-chunk-length buckets, engine.py)."""
     out, b = [], 1
     while b < max_batch:
         out.append(b)
         b *= 2
     out.append(max_batch)
     return out
+
+
+_pow2_buckets = pow2_buckets  # back-compat alias
 
 
 class MicroBatcher:
@@ -110,7 +117,7 @@ class MicroBatcher:
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.batch_window_s = float(batch_window_s)
-        self.buckets = _pow2_buckets(self.max_batch)
+        self.buckets = pow2_buckets(self.max_batch)
         self.metrics = metrics if metrics is not None else default_registry()
         self._name = name
         self._queue: List[_Request] = []

@@ -8,9 +8,11 @@ scheduler (continuous batching) over the existing attention KV cache:
   - a fixed number of decode *slots* (the batch dimension of one shared,
     per-layer KV cache / recurrent state pytree);
   - each engine step runs ALL slots through ONE jitted single-token
-    forward — the XLA program is compiled exactly once, for the
-    [n_slots, 1, vocab] shape, and never recompiles as sequences come
-    and go;
+    forward — int32 token ids in (the one-hot is built on device inside
+    the program, so per-step host->device traffic is n_slots ints, not a
+    dense [n_slots, 1, vocab] float batch), next-token distributions out.
+    The XLA program is compiled exactly once and never recompiles as
+    sequences come and go;
   - new sequences are admitted into free slots *between* steps (their
     slot's state rows are zeroed and, for attention layers, the per-slot
     cache position — `nn/layers/attention.py` vector-``pos`` plumbing —
@@ -19,12 +21,31 @@ scheduler (continuous batching) over the existing attention KV cache:
   - finished sequences (max tokens or EOS) are evicted the step they
     finish, freeing the slot for the next queued request.
 
-Prompts are prefilled token-by-token through the same step — prefill and
-decode are one program, which is what keeps admission recompile-free. Token
-selection reuses `models/sampling.sample_logits`, so greedy engine output
-is token-identical to solo `generate_transformer(use_cache=True)` decoding
-(tested), and seeded sampled output matches too (same per-sequence RNG
-consumption order).
+Chunked prefill (the ISSUE 2 tentpole): prompts no longer prefill
+token-by-token. A second family of jitted programs — one per power-of-two
+chunk bucket (16/32/64/... up to ``prefill_chunk``, reusing the batcher's
+bucket helper) — runs C prompt tokens through the net in ONE forward for a
+single slot: the slot's state rows are sliced out of the shared pytree,
+the chunk writes K/V rows ``[pos, pos+C)`` in one offset
+`dynamic_update_slice` (RoPE phases from the slot's absolute positions,
+causal masking within the chunk), and the rows are scattered back. Nets
+with recurrent h/c state (LSTM/GRU facades) prefill through an equivalent
+`lax.scan` chunk program — C single-token steps fused into one device
+dispatch, padded steps masked out of the state carry. Time-to-first-token
+drops from O(prompt_len) to O(prompt_len / C) engine steps.
+
+Scheduling is Sarathi-style: each iteration runs AT MOST ONE bounded
+prefill chunk alongside the regular all-slots decode step, so decode
+latency for resident sequences stays protected while admitted prompts
+still prefill C tokens per iteration. Slots that are mid-prefill (or idle)
+are masked out of the decode step *inside* the jitted program — their
+recurrent state and cache position are frozen by a `live` mask, so the
+shared-batch step cannot corrupt a half-prefilled slot.
+
+Token selection reuses `models/sampling.sample_logits`, so greedy engine
+output is token-identical to solo `generate_transformer(use_cache=True)`
+decoding (tested, chunked and token-by-token), and seeded sampled output
+matches too (same per-sequence RNG consumption order).
 
 Works for both facades: transformer ComputationGraphs (KV-cache states)
 and recurrent MultiLayerNetworks (h/c states — admitting a sequence zeroes
@@ -34,7 +55,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +65,13 @@ from ..models.sampling import sample_logits
 from ..nn.layers.recurrent import (BaseRecurrentImpl,
                                    _materialize_rnn_states)
 from ..nn.multilayer import _compute_dtype_of
+from .batcher import QueueFullError, pow2_buckets
 from .metrics import MetricsRegistry, default_registry
+
+# chunk buckets never go below this (a 3-token tail still pads to one
+# small program instead of compiling a 3-wide one-off); buckets smaller
+# than 16 only exist when prefill_chunk itself is smaller
+_MIN_CHUNK_BUCKET = 16
 
 
 class DecodeHandle:
@@ -55,10 +82,15 @@ class DecodeHandle:
         self.max_new_tokens = max_new_tokens
         self.tokens: List[int] = []
         self._done = threading.Event()
+        self._cancel = threading.Event()
         self._error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
+        # engine iterations this sequence was stepped before its first
+        # token (the bench's TTFT-in-steps: prompt_len token-by-token,
+        # ceil(prompt_len / chunk) chunked)
+        self.steps_to_first_token: Optional[int] = None
 
     def _finish(self, err: Optional[BaseException] = None) -> None:
         self._error = err
@@ -67,6 +99,20 @@ class DecodeHandle:
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def cancel(self) -> None:
+        """Ask the scheduler to evict this sequence at its next step.
+
+        Without this, a caller that times out waiting on `result()` leaks
+        its slot: the sequence keeps decoding to max_new_tokens with
+        nobody reading the answer. Cancellation is asynchronous — the
+        scheduler thread frees the slot, counts `decode_cancelled_total`,
+        and marks the handle done (with whatever tokens were produced).
+        Cancelling a finished handle is a no-op."""
+        self._cancel.set()
+
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self._done.wait(timeout):
@@ -79,7 +125,7 @@ class DecodeHandle:
 class _ActiveSeq:
     """Book-keeping for one slot-resident sequence."""
     __slots__ = ("handle", "prompt", "fed", "rng", "temperature", "top_k",
-                 "top_p", "eos_id")
+                 "top_p", "eos_id", "steps")
 
     def __init__(self, handle: DecodeHandle, prompt: Sequence[int],
                  temperature: float, top_k: Optional[int],
@@ -92,6 +138,7 @@ class _ActiveSeq:
         self.top_k = top_k
         self.top_p = top_p
         self.eos_id = eos_id
+        self.steps = 0  # engine iterations that advanced this sequence
 
     def next_input(self) -> int:
         """Token to feed this step: the next prompt token while prefilling,
@@ -116,10 +163,16 @@ class DecodeScheduler:
     own streaming API concurrently (single-threaded model access is still
     required; the engine's step thread is that single thread while
     running).
+
+    ``prefill_chunk``: max prompt tokens per prefill program (the TTFT /
+    decode-latency knob — bigger chunks reach the first token in fewer
+    iterations but each chunked iteration holds the device longer, adding
+    tail latency to resident decodes). <= 1 disables chunked prefill and
+    restores token-by-token prompt feeding through the decode step.
     """
 
     def __init__(self, net, vocab_size: int, *, n_slots: int = 4,
-                 max_queue: int = 64,
+                 max_queue: int = 64, prefill_chunk: int = 64,
                  metrics: Optional[MetricsRegistry] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
@@ -127,6 +180,7 @@ class DecodeScheduler:
         self.vocab_size = int(vocab_size)
         self.n_slots = int(n_slots)
         self.max_queue = int(max_queue)
+        self.prefill_chunk = int(prefill_chunk)
         self.metrics = metrics if metrics is not None else default_registry()
         self._graph = hasattr(net.conf, "vertices")  # facade detection
         self._dtype = _compute_dtype_of(net.conf.conf)
@@ -138,6 +192,28 @@ class DecodeScheduler:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._jstep = jax.jit(self._step_fn)
+        # one prefill program per pow2 chunk bucket (the SAME jitted
+        # callable; each distinct ids length C is its own XLA program,
+        # compiled once and reused across requests — the batcher's
+        # compile-once-per-bucket discipline applied to prefill)
+        self._jprefill = jax.jit(self._prefill_fn)
+        if self.prefill_chunk > 1:
+            lo = min(_MIN_CHUNK_BUCKET, self.prefill_chunk)
+            self.prefill_buckets = [b for b in pow2_buckets(self.prefill_chunk)
+                                    if b >= lo]
+        else:
+            self.prefill_buckets = []
+        # dense chunk path needs every stateful layer to take a multi-token
+        # inference step (true of the attention KV cache: offset
+        # dynamic_update_slice writes + in-chunk causal mask). Recurrent
+        # h/c state steps one token at a time, so those nets prefill
+        # through the lax.scan chunk program instead.
+        stateful = [impl for _, impl in self._impl_items()
+                    if isinstance(impl, BaseRecurrentImpl)]
+        self._chunk_dense = bool(stateful) and all(
+            type(impl).__name__ == "SelfAttentionLayerImpl"
+            for impl in stateful)
+        self._prefill_next = 0  # round-robin over prefilling slots
         m = self.metrics
         self._m_queue_depth = m.gauge("decode_queue_depth")
         self._m_active = m.gauge("decode_active_slots")
@@ -147,9 +223,14 @@ class DecodeScheduler:
         self._m_tokens = m.counter("decode_tokens_total")
         self._m_seqs = m.counter("decode_sequences_total")
         self._m_rejected = m.counter("decode_rejected_total")
+        self._m_cancelled = m.counter("decode_cancelled_total")
         self._m_latency = m.histogram("decode_seq_latency_sec")
         self._m_ttft = m.histogram("decode_time_to_first_token_sec")
         self._m_step_time = m.histogram("decode_step_time_sec")
+        self._m_prefill_tokens = m.counter("prefill_tokens_total")
+        self._m_prefill_chunk = m.histogram(
+            "prefill_chunk_size", lo=1.0,
+            hi=float(max(self.prefill_buckets or [1])) + 1, per_decade=12)
 
     # -- model plumbing ----------------------------------------------------
     def _impl_items(self):
@@ -175,9 +256,9 @@ class DecodeScheduler:
                                "pos": jnp.zeros((self.n_slots,), jnp.int32)}
         return states
 
-    def _step_fn(self, params, variables, x, states):
-        """One single-token forward for all slots: [n_slots, 1, V] one-hot
-        in, last-position next-token distribution [n_slots, V] out."""
+    def _forward(self, params, variables, x, states):
+        """One forward of [B, T, vocab] one-hots through the net with
+        explicit states: ([B, T, vocab] distributions, new states)."""
         if self._graph:
             acts, _, new_states = self.net._forward_impl(
                 params, variables, [x], train=False, rng=None, states=states)
@@ -186,7 +267,140 @@ class DecodeScheduler:
             acts, _, new_states = self.net._forward_impl(
                 params, variables, x, train=False, rng=None, states=states)
             out = acts[-1]
-        return out[:, -1, :], new_states
+        return out, new_states
+
+    def _freeze_states(self, new_states, old_states, live):
+        """Keep only live slots' state transitions: masked rows (idle or
+        mid-chunked-prefill slots stepped as padding of the shared batch)
+        retain their previous recurrent state and cache position. K/V
+        buffers are exempt — a masked slot's write lands at its own frozen
+        `pos` row, which is overwritten by the slot's next real write (its
+        next prefill chunk starts at `pos`) and causally invisible until
+        then, so freezing the (large) cache buffers would be pure cost."""
+        def sel(n, o):
+            m = live.reshape((self.n_slots,) + (1,) * (n.ndim - 1))
+            return jnp.where(m, n, o)
+        out = {}
+        for key, st in new_states.items():
+            old = old_states[key]
+            if isinstance(st, dict):
+                out[key] = {k: (v if k in ("k", "v") else sel(v, old[k]))
+                            for k, v in st.items()}
+            else:
+                out[key] = sel(st, old)
+        return out
+
+    def _step_fn(self, params, variables, ids, live, states):
+        """One single-token forward for all slots. ``ids``: [n_slots]
+        int32 token ids (the one-hot is built HERE, on device — the host
+        ships vocab-fold less data per step); ``live``: [n_slots] bool,
+        False rows are batch padding whose state must not advance.
+        Returns ([n_slots, vocab] next-token distributions, new states)."""
+        x = jax.nn.one_hot(ids, self.vocab_size, dtype=self._dtype)[:, None]
+        out, new_states = self._forward(params, variables, x, states)
+        return out[:, -1, :], self._freeze_states(new_states, states, live)
+
+    # -- chunked prefill programs ------------------------------------------
+    def _slice_slot(self, states, slot):
+        """One slot's rows of every state leaf, batch dim kept at 1."""
+        def f(a):
+            if hasattr(a, "ndim") and a.ndim >= 1 \
+                    and a.shape[0] == self.n_slots:
+                return jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=0)
+            return a
+        return jax.tree_util.tree_map(f, states)
+
+    def _scatter_slot(self, states, sub, slot):
+        """Write a batch-1 state pytree back into one slot's rows."""
+        def f(full, part):
+            if hasattr(full, "ndim") and full.ndim >= 1 \
+                    and full.shape[0] == self.n_slots:
+                return jax.lax.dynamic_update_slice_in_dim(
+                    full, part, slot, axis=0)
+            return part
+        return jax.tree_util.tree_map(f, states, sub)
+
+    def _prefill_fn(self, params, variables, slot, ids, n_real, states):
+        """Prefill one chunk of ``ids`` (int32 [C], padded past ``n_real``)
+        into ``slot``'s state, in ONE device dispatch. Returns the
+        next-token distribution at the last REAL prompt token (only
+        meaningful for the prompt's final chunk) and the updated shared
+        states. Compiled once per chunk length C (the pow2 buckets).
+
+        Dense path (attention nets): a single [1, C, vocab] forward —
+        `nn/layers/attention.py` writes K/V rows [pos, pos+C) in one
+        offset `dynamic_update_slice`, rotates RoPE at the slot's absolute
+        positions, and masks causally within the chunk. Padded tail rows
+        beyond n_real land at positions the corrected `pos` keeps causally
+        invisible until the next real write overwrites them; `pos` itself
+        advances by n_real, not C.
+
+        Scan path (recurrent h/c state): C single-token steps fused into
+        one `lax.scan` program; padded steps keep the carried state (the
+        same mask-carry discipline the training scan uses)."""
+        sub = self._slice_slot(states, slot)
+        if self._chunk_dense:
+            x = jax.nn.one_hot(ids, self.vocab_size, dtype=self._dtype)[None]
+            out, new_sub = self._forward(params, variables, x, sub)
+            probs = jax.lax.dynamic_index_in_dim(out, n_real - 1, axis=1,
+                                                 keepdims=False)[0]
+            fixed = {}
+            for key, st in new_sub.items():
+                if isinstance(st, dict) and "pos" in st:
+                    # the layer advanced pos by the PADDED chunk length;
+                    # the sequence is only n_real tokens deeper. But keep
+                    # the layer's L_cap+1 overflow-freeze sentinel (ADVICE
+                    # r3): a chunk that overran the cache must stay
+                    # poisoned, not resume over a corrupted cache
+                    pos = sub[key]["pos"] + n_real
+                    if "k" in st:
+                        cap = st["k"].shape[1]
+                        pos = jnp.where(st["pos"] > cap, st["pos"], pos)
+                    fixed[key] = {**st, "pos": pos}
+                else:
+                    fixed[key] = st
+            new_sub = fixed
+        else:
+            keep = jnp.arange(ids.shape[0], dtype=jnp.int32) < n_real
+
+            def body(carry, inp):
+                tok, k = inp
+                x = jax.nn.one_hot(tok[None, None], self.vocab_size,
+                                   dtype=self._dtype)
+                out, ns = self._forward(params, variables, x, carry)
+                nxt = {}
+                for key, st in ns.items():
+                    old = carry[key]
+                    if isinstance(st, dict):
+                        nxt[key] = {k2: jnp.where(k, v2, old[k2])
+                                    for k2, v2 in st.items()}
+                    else:
+                        nxt[key] = jnp.where(k, st, old)
+                return nxt, out[0, -1, :]
+
+            new_sub, probs_all = jax.lax.scan(body, sub, (ids, keep))
+            probs = probs_all[n_real - 1]
+        return probs, self._scatter_slot(states, new_sub, slot)
+
+    def _pick_chunk(self, seq: _ActiveSeq) -> Tuple[int, int]:
+        """(bucket, n_real) for this sequence's next prefill chunk, or
+        (0, 0) when no bucket fits the KV-cache headroom (the tail then
+        prefills token-by-token through the decode step)."""
+        remaining = len(seq.prompt) - seq.fed
+        n_real = min(remaining, self.prefill_chunk)
+        bucket = next(b for b in self.prefill_buckets if b >= n_real)
+        if self._cache_cap is not None and \
+                seq.fed + bucket > self._cache_cap:
+            # padded writes past the cap would trip the layer's overflow
+            # guard even though the real tokens fit: shrink to the largest
+            # bucket inside the headroom
+            fitting = [b for b in self.prefill_buckets
+                       if seq.fed + b <= self._cache_cap]
+            if not fitting:
+                return 0, 0
+            bucket = fitting[-1]
+            n_real = min(n_real, bucket)
+        return bucket, n_real
 
     def _reset_slot_state(self, slot: int) -> None:
         """Zero one slot's rows across every state leaf (KV rows, cache
@@ -198,19 +412,6 @@ class DecodeScheduler:
             return a
         self._states = jax.tree_util.tree_map(zero_row, self._states)
 
-    def _reset_idle_positions(self, idle: List[int]) -> None:
-        """Pin idle slots' cache positions back to 0 (they are stepped with
-        zero inputs as part of the batch, so their depth would otherwise
-        creep toward the cache cap). Their stale K/V needs no wipe — it is
-        zeroed at admission and causally masked until then."""
-        if not idle:
-            return
-        idx = jnp.asarray(idle)
-        for key, st in self._states.items():
-            if isinstance(st, dict) and "pos" in st and st["pos"].ndim:
-                self._states[key] = {**st,
-                                     "pos": st["pos"].at[idx].set(0)}
-
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: Optional[int] = None,
@@ -218,6 +419,18 @@ class DecodeScheduler:
                eos_id: Optional[int] = None) -> DecodeHandle:
         if not len(prompt_ids):
             raise ValueError("prompt_ids must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        bad = [int(t) for t in prompt_ids
+               if not 0 <= int(t) < self.vocab_size]
+        if bad:
+            # ids arrive from untrusted JSON (/generate); out-of-range ids
+            # would one-hot to silent all-zero rows, decoding confidently
+            # from a "no token" input
+            raise ValueError(
+                f"prompt ids out of range [0, {self.vocab_size}): "
+                f"{bad[:5]}")
         if self._cache_cap is not None:
             needed = len(prompt_ids) + max(max_new_tokens - 1, 0)
             if needed > self._cache_cap:
@@ -233,7 +446,7 @@ class DecodeScheduler:
                 raise RuntimeError("scheduler is not running (call start())")
             if len(self._queue) >= self.max_queue:
                 self._m_rejected.inc()
-                raise RuntimeError(
+                raise QueueFullError(
                     f"decode queue full ({self.max_queue} waiting)")
             self._queue.append(seq)
             self._m_queue_depth.set(len(self._queue))
@@ -242,8 +455,16 @@ class DecodeScheduler:
 
     def generate(self, prompt_ids: Sequence[int], max_new_tokens: int,
                  timeout: Optional[float] = 120.0, **kw) -> List[int]:
-        """Blocking submit — drop-in for `generate_transformer` greedy."""
-        return self.submit(prompt_ids, max_new_tokens, **kw).result(timeout)
+        """Blocking submit — drop-in for `generate_transformer` greedy.
+        A timed-out wait CANCELS the request (the slot is reclaimed at the
+        scheduler's next step instead of decoding to max_new_tokens for a
+        caller that already gave up)."""
+        handle = self.submit(prompt_ids, max_new_tokens, **kw)
+        try:
+            return handle.result(timeout)
+        except TimeoutError:
+            handle.cancel()
+            raise
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "DecodeScheduler":
@@ -273,23 +494,88 @@ class DecodeScheduler:
                 self._slots[i] = None
 
     # -- scheduler loop ----------------------------------------------------
+    def _evict_cancelled(self) -> None:
+        for i, seq in enumerate(self._slots):
+            if seq is not None and seq.handle.cancelled():
+                self._m_cancelled.inc()
+                seq.handle._finish()  # partial tokens, caller already left
+                self._slots[i] = None
+
     def _admit(self) -> None:
         with self._cond:
             for i in range(self.n_slots):
-                if self._slots[i] is not None or not self._queue:
+                if self._slots[i] is not None:
                     continue
-                seq = self._queue.pop(0)
-                self._reset_slot_state(i)
-                self._slots[i] = seq
-                self._m_seqs.inc()
+                while self._queue:
+                    seq = self._queue.pop(0)
+                    if seq.handle.cancelled():  # gave up while queued
+                        self._m_cancelled.inc()
+                        seq.handle._finish()
+                        continue
+                    self._reset_slot_state(i)
+                    self._slots[i] = seq
+                    self._m_seqs.inc()
+                    break
             self._m_queue_depth.set(len(self._queue))
             self._m_active.set(sum(s is not None for s in self._slots))
+
+    def _consume(self, slot: int, seq: _ActiveSeq,
+                 probs_row: np.ndarray) -> None:
+        """Sample one output token from a next-token distribution row;
+        finish + evict on max_new_tokens or EOS. Shared by the decode step
+        and the final prefill chunk (whose last-real-token distribution
+        yields the first output token)."""
+        h = seq.handle
+        tok = sample_logits(probs_row, seq.temperature, seq.top_k,
+                            seq.rng, seq.top_p)
+        h.tokens.append(tok)
+        self._m_tokens.inc()
+        now = time.monotonic()
+        if h.t_first_token is None:
+            h.t_first_token = now
+            h.steps_to_first_token = seq.steps
+            self._m_ttft.record(now - h.t_submit)
+        if (len(h.tokens) >= h.max_new_tokens
+                or (seq.eos_id is not None and tok == seq.eos_id)):
+            h._finish()
+            self._m_latency.record(now - h.t_submit)
+            self._slots[slot] = None
+
+    def _run_prefill_chunk(self) -> Optional[int]:
+        """At most one bounded prefill chunk per iteration (round-robin
+        over prefilling slots). Returns the chunked slot index, or None."""
+        if not self.prefill_buckets:
+            return None
+        for off in range(self.n_slots):
+            i = (self._prefill_next + off) % self.n_slots
+            seq = self._slots[i]
+            if seq is None or seq.fed >= len(seq.prompt):
+                continue
+            bucket, n_real = self._pick_chunk(seq)
+            if not n_real:
+                continue  # no cache headroom: token-by-token fallback
+            ids = np.zeros((bucket,), np.int32)
+            ids[:n_real] = seq.prompt[seq.fed:seq.fed + n_real]
+            probs, self._states = self._jprefill(
+                self.net.params, self.net.variables,
+                jnp.asarray(i, jnp.int32), jnp.asarray(ids),
+                jnp.asarray(n_real, jnp.int32), self._states)
+            seq.fed += n_real
+            seq.steps += 1
+            self._m_prefill_tokens.inc(n_real)
+            self._m_prefill_chunk.record(n_real)
+            if seq.sampling:  # final chunk: its output is the first token
+                self._consume(i, seq, np.asarray(probs))
+            self._prefill_next = (i + 1) % self.n_slots
+            return i
+        return None
 
     def _loop(self) -> None:
         while True:
             with self._cond:
                 if not self._running:
                     return  # stop() fails any still-active handles
+            self._evict_cancelled()
             self._admit()
             active = [(i, s) for i, s in enumerate(self._slots)
                       if s is not None]
@@ -301,37 +587,36 @@ class DecodeScheduler:
                         self._cond.wait(timeout=0.1)
                 continue
             t0 = time.monotonic()
-            x = np.zeros((self.n_slots, 1, self.vocab_size), np.float32)
+            chunked = self._run_prefill_chunk()
+            # decode step: every decode-ready slot, plus token-by-token
+            # prefill for slots chunked prefill cannot serve (disabled, or
+            # no bucket fits the remaining cache headroom)
+            fed: List[Tuple[int, _ActiveSeq]] = []
             for i, seq in active:
-                x[i, 0, seq.next_input()] = 1.0
-            probs, new_states = self._jstep(self.net.params,
-                                            self.net.variables,
-                                            jnp.asarray(x), self._states)
-            self._states = new_states
-            probs = np.asarray(probs)
+                if self._slots[i] is not seq or i == chunked:
+                    continue  # evicted above / consumed its iteration
+                if not seq.sampling and self.prefill_buckets \
+                        and self._pick_chunk(seq)[1]:
+                    continue  # mid-prefill: waits for its chunk turn
+                fed.append((i, seq))
+            if fed:
+                ids = np.zeros((self.n_slots,), np.int32)
+                live = np.zeros((self.n_slots,), bool)
+                for i, seq in fed:
+                    ids[i] = seq.next_input()
+                    live[i] = True
+                probs, new_states = self._jstep(
+                    self.net.params, self.net.variables, jnp.asarray(ids),
+                    jnp.asarray(live), self._states)
+                self._states = new_states
+                probs = np.asarray(probs)
+                for i, seq in fed:
+                    seq.steps += 1
+                    was_sampling = seq.sampling
+                    if seq.fed < len(seq.prompt):
+                        seq.fed += 1
+                    if not was_sampling and not seq.sampling:
+                        continue  # still prefilling; output not sampled yet
+                    self._consume(i, seq, probs[i])
             self._m_occupancy.record(len(active))
             self._m_step_time.record(time.monotonic() - t0)
-            for i, seq in active:
-                was_sampling = seq.sampling
-                if seq.fed < len(seq.prompt):
-                    seq.fed += 1
-                if not was_sampling and not seq.sampling:
-                    continue  # still prefilling; output not sampled yet
-                h = seq.handle
-                tok = sample_logits(probs[i], seq.temperature, seq.top_k,
-                                    seq.rng, seq.top_p)
-                h.tokens.append(tok)
-                self._m_tokens.inc()
-                now = time.monotonic()
-                if h.t_first_token is None:
-                    h.t_first_token = now
-                    self._m_ttft.record(now - h.t_submit)
-                if (len(h.tokens) >= h.max_new_tokens
-                        or (seq.eos_id is not None and tok == seq.eos_id)):
-                    h._finish()
-                    self._m_latency.record(now - h.t_submit)
-                    self._slots[i] = None
-            # frozen-depth guard: a free slot's position must not keep
-            # advancing toward the cache cap while the slot idles
-            self._reset_idle_positions(
-                [i for i in range(self.n_slots) if self._slots[i] is None])

@@ -216,8 +216,11 @@ class MetricsRegistry:
         for n, h in snap["histograms"].items():
             lines.append(f"# TYPE {n} summary")
             if h.get("count"):
-                for q in ("p50", "p95", "p99"):
-                    lines.append(f'{n}{{quantile="{q[1:]}"}} {h[q]}')
+                # Prometheus summary convention: fractional quantile
+                # labels ({quantile="0.5"}), not percentile numbers
+                for q, frac in (("p50", "0.5"), ("p95", "0.95"),
+                                ("p99", "0.99")):
+                    lines.append(f'{n}{{quantile="{frac}"}} {h[q]}')
                 lines.append(f"{n}_sum {h['sum']}")
             lines.append(f"{n}_count {h.get('count', 0)}")
         return "\n".join(lines) + "\n"

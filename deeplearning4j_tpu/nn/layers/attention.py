@@ -11,6 +11,14 @@ Streaming inference: the impl extends the recurrent-state protocol
 for transformers exactly like for LSTMs: O(L_max) per token instead of
 re-forwarding the full context. Training always runs the full-sequence
 path; the cache exists only on the inference step path.
+
+The cached step is multi-token and per-slot: ``pos`` may be a [B] vector
+(each batch row decoding at its own depth — the serving engine's slot
+scheduling) and the incoming x may carry T > 1 timesteps (chunked
+prefill, inference/engine.py): a chunk's K/V rows land at [pos, pos+T)
+via per-row offset `dynamic_update_slice`, RoPE rotates at each row's
+absolute positions, and the causal mask covers both the cache depth AND
+query order within the chunk (`_grouped_attention` qpos0).
 """
 from __future__ import annotations
 
@@ -183,8 +191,11 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
                            mask=None):
         """Full-sequence attention when training or uncached (state passes
         through untouched); KV-cached incremental attention when an
-        inference step arrives with a cache state. Positions beyond
-        `max_cache_len` are unsupported (fixed-capacity cache)."""
+        inference step arrives with a cache state. The step takes any T
+        (T=1 decode, T=C chunked prefill) at scalar or per-row [B]
+        positions; positions beyond `max_cache_len` are unsupported
+        (fixed-capacity cache — chunk callers must keep pos+T <= cap,
+        padding included: the overflow guard sees the PADDED length)."""
         if train or state0 is None:
             y, _ = self.forward(params, x, train=train, rng=rng, mask=mask)
             return y, state0

@@ -16,6 +16,15 @@ that need strict FIFO with zero batching delay). SLO telemetry (queue
 depth, batch occupancy, time-in-queue, latency percentiles, timeout/reject
 counts) lives in a `MetricsRegistry` exported at `GET /metrics`.
 
+Generative serving: pass ``decode_vocab`` (the LM's vocabulary size) and
+the server additionally runs a `inference.DecodeScheduler` — slot-based
+continuous-batching decode with chunked prefill — behind `POST /generate`.
+``prefill_chunk`` is the TTFT / decode-latency knob (`dl4j-tpu serve
+--generate --prefill-chunk C`); the scheduler's metrics (TTFT, prefill
+tokens, chunk sizes, cancellations) land in the same registry as the
+request-path metrics, so `GET /metrics` and the UI `/serving` page show
+the whole hot path.
+
 Endpoints:
   GET  /health            {"status": "ok", "model": "...", "params": N}
   GET  /info              model summary + config JSON
@@ -26,6 +35,12 @@ Endpoints:
                           expired request gets HTTP 504, a full queue 503)
   POST /predict/csv       text/plain CSV rows     -> same, via the
                           RecordToDataSetConverter (label column ignored)
+  POST /generate          {"prompt": [ids], "max_new_tokens": N,
+                          "temperature"/"top_k"/"top_p"/"seed"/"eos_id"?}
+                          -> {"tokens": [ids]}; 400 unless the server was
+                          started with decode_vocab. A ?timeout_ms expiry
+                          CANCELS the decode (slot reclaimed) -> HTTP 504;
+                          a full decode queue -> HTTP 503
 """
 from __future__ import annotations
 
@@ -38,8 +53,8 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from ..inference import (MetricsRegistry, MicroBatcher, QueueFullError,
-                         RequestTimeoutError)
+from ..inference import (DecodeScheduler, MetricsRegistry, MicroBatcher,
+                         QueueFullError, RequestTimeoutError)
 from .streaming import RecordToDataSetConverter
 
 
@@ -50,12 +65,15 @@ class InferenceServer:
                  batching: bool = True, batch_window_ms: float = 2.0,
                  max_queue: int = 256,
                  default_timeout_ms: Optional[float] = None,
+                 decode_vocab: Optional[int] = None, decode_slots: int = 4,
+                 prefill_chunk: int = 64, decode_queue: int = 64,
                  metrics: Optional[MetricsRegistry] = None):
         if net is None:
             if model_path is None:
                 raise ValueError("pass a net or a model_path")
-            from ..util.model_serializer import restore_multi_layer_network
-            net = restore_multi_layer_network(model_path)
+            from ..util.model_serializer import restore_model
+            net = restore_model(model_path)  # MLN or ComputationGraph,
+            # dispatched on the zip's model_type stamp
         self.net = net
         self.max_batch = max_batch
         self.converter = converter or RecordToDataSetConverter(label_index=None)
@@ -63,6 +81,11 @@ class InferenceServer:
         self.batch_window_ms = float(batch_window_ms)
         self.max_queue = int(max_queue)
         self.default_timeout_ms = default_timeout_ms
+        self.decode_vocab = decode_vocab
+        self.decode_slots = int(decode_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        self.decode_queue = int(decode_queue)
+        self._decoder: Optional[DecodeScheduler] = None
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -82,6 +105,16 @@ class InferenceServer:
     def port(self) -> int:
         return self._httpd.server_address[1] if self._httpd else self._port
 
+    def _net_output(self, arr: np.ndarray) -> np.ndarray:
+        """One forward through either facade. ComputationGraph.output
+        returns a LIST of output arrays — /predict's contract is one
+        prediction tensor, so take the (first) output; without this the
+        row-wise batching/scatter would slice the outputs axis."""
+        out = self.net.output(arr)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return np.asarray(out)
+
     def _batcher_for(self, arr: np.ndarray) -> Optional[MicroBatcher]:
         sig = (arr.shape[1:], str(arr.dtype))
         with self._batchers_lock:
@@ -90,7 +123,7 @@ class InferenceServer:
                 if len(self._batchers) >= self.max_signatures:
                     return None  # signature-cap overflow: direct path
                 b = MicroBatcher(
-                    lambda a: np.asarray(self.net.output(a)),
+                    self._net_output,
                     max_batch=self.max_batch, max_queue=self.max_queue,
                     batch_window_s=self.batch_window_ms / 1e3,
                     metrics=self.metrics, name="predict").start()
@@ -108,8 +141,7 @@ class InferenceServer:
         outs = []
         with self._lock:
             for off in range(0, arr.shape[0], self.max_batch):
-                outs.append(np.asarray(
-                    self.net.output(arr[off:off + self.max_batch])))
+                outs.append(self._net_output(arr[off:off + self.max_batch]))
         return np.concatenate(outs) if outs else np.zeros((0, 0), np.float32)
 
     def _predict(self, arr: np.ndarray,
@@ -124,8 +156,30 @@ class InferenceServer:
             if out.ndim >= 2 and out.shape[-1] > 0 else [],
         }
 
+    def _generate(self, payload: dict,
+                  timeout_ms: Optional[float]) -> dict:
+        if self._decoder is None:
+            raise ValueError("generation is disabled: start the server "
+                             "with decode_vocab (CLI: --generate)")
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        kw = {k: payload[k] for k in ("temperature", "top_k", "top_p",
+                                      "seed", "eos_id") if k in payload}
+        tokens = self._decoder.generate(
+            [int(t) for t in payload["prompt"]],
+            int(payload.get("max_new_tokens", 16)),
+            timeout=timeout_ms / 1e3 if timeout_ms is not None else 120.0,
+            **kw)
+        return {"tokens": tokens}
+
     def start(self) -> "InferenceServer":
         server = self
+        if self.decode_vocab is not None and self._decoder is None:
+            self._decoder = DecodeScheduler(
+                self.net, self.decode_vocab, n_slots=self.decode_slots,
+                max_queue=self.decode_queue,
+                prefill_chunk=self.prefill_chunk,
+                metrics=self.metrics).start()
         m_http = self.metrics.counter("http_requests_total")
         m_err = self.metrics.counter("http_errors_total")
 
@@ -189,9 +243,14 @@ class InferenceServer:
                         payload = json.loads(raw.decode())
                         arr = np.asarray(payload["data"], np.float32)
                         self._send(server._predict(arr, timeout_ms))
+                    elif url.path == "/generate":
+                        self._send(server._generate(
+                            json.loads(raw.decode()), timeout_ms))
                     else:
                         self._send({"error": "not found"}, 404)
-                except RequestTimeoutError as e:
+                except TimeoutError as e:  # incl. RequestTimeoutError and
+                    # decode-scheduler timeouts (the decode is cancelled
+                    # by generate() before the error propagates here)
                     m_err.inc()
                     self._send({"error": f"deadline exceeded: {e}"}, 504)
                 except QueueFullError as e:
@@ -212,6 +271,9 @@ class InferenceServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._decoder is not None:
+            self._decoder.stop()
+            self._decoder = None
         with self._batchers_lock:
             batchers = list(self._batchers.values())
             self._batchers.clear()

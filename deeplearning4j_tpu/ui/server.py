@@ -280,6 +280,7 @@ _SERVING_PAGE = """<!DOCTYPE html>
 <body style="font-family:sans-serif">
 <h2>Serving SLO metrics</h2>
 <div id="meta"></div>
+<div id="decode" style="color:#555"></div>
 <table id="t" border="1" cellpadding="4" style="border-collapse:collapse">
 </table>
 <script>
@@ -288,6 +289,17 @@ async function refresh() {
   const m = d.metrics || {};
   document.getElementById('meta').innerText =
     'uptime: ' + (m.uptime_sec || 0) + 's';
+  const c = m.counters || {}, h = m.histograms || {};
+  const ttft = h.decode_time_to_first_token_sec, ck = h.prefill_chunk_size;
+  if (c.prefill_tokens_total !== undefined || ttft)
+    document.getElementById('decode').innerText =
+      'decode: ' + (c.decode_tokens_total || 0) + ' tokens, ' +
+      (c.prefill_tokens_total || 0) + ' prefilled' +
+      (ck && ck.count ? ' (chunk p50 ' + ck.p50 + ')' : '') +
+      (ttft && ttft.count ? ', TTFT p50 ' +
+        (ttft.p50 * 1000).toFixed(1) + 'ms' : '') +
+      (c.decode_cancelled_total ? ', ' + c.decode_cancelled_total +
+        ' cancelled' : '');
   let rows = '<tr><th>metric</th><th>value</th></tr>';
   for (const [k, v] of Object.entries(m.counters || {}))
     rows += '<tr><td>' + k + '</td><td>' + v + '</td></tr>';

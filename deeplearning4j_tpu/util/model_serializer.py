@@ -74,6 +74,22 @@ def restore_multi_layer_network(path: Union[str, Path], load_updater: bool = Tru
     return net
 
 
+def restore_model(path: Union[str, Path], load_updater: bool = True):
+    """Type-dispatching restore: reads the zip's META_JSON ``model_type``
+    stamped by `write_model` and returns the matching facade
+    (MultiLayerNetwork or ComputationGraph). Zips predating the stamp
+    restore as MultiLayerNetwork (the only type they could hold)."""
+    with zipfile.ZipFile(Path(path), "r") as zf:
+        names = set(zf.namelist())
+        model_type = "MultiLayerNetwork"
+        if META_JSON in names:
+            model_type = json.loads(zf.read(META_JSON).decode()).get(
+                "model_type", model_type)
+    if model_type == "ComputationGraph":
+        return restore_computation_graph(path, load_updater)
+    return restore_multi_layer_network(path, load_updater)
+
+
 def restore_computation_graph(path: Union[str, Path], load_updater: bool = True):
     """Reference restoreComputationGraph."""
     from ..nn.conf.graph import ComputationGraphConfiguration

@@ -67,7 +67,8 @@ def test_registry_snapshot_and_text():
     assert snap["gauges"]["depth"]["value"] == 7
     assert snap["histograms"]["lat"]["count"] == 1
     text = m.render_text()
-    assert "reqs 3" in text and 'lat{quantile="50"}' in text
+    # fractional quantile labels, the Prometheus summary convention
+    assert "reqs 3" in text and 'lat{quantile="0.5"}' in text
 
 
 def test_metrics_post_to_ui_serving_page():
