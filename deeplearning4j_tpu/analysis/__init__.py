@@ -1,0 +1,50 @@
+"""graftlint: JAX-aware static analysis + runtime audit harness.
+
+The serving stack's correctness rests on invariants no ordinary unit test
+states in general form: jitted program families must not silently grow
+(recompile storms), decode/prefill hot loops must not block on
+host<->device syncs, and the threaded modules must not deadlock. This
+package machine-enforces them, twice over:
+
+  - **statically** (`core`, `jax_rules`, `concurrency_rules`, `lint`): an
+    AST linter with a JAX rule pack (host syncs in traced/hot code, Python
+    branches on tracers, jit closing over mutable globals, missing
+    static_argnums, impure calls under trace) and a concurrency rule pack
+    (lock-acquisition-order graph with cycle detection, blocking calls
+    under a lock, `Condition.wait` outside a predicate loop, torn
+    reads of lock-guarded state). Findings diff against a committed
+    baseline (`baseline.json`) so CI fails on *new* violations only;
+    inline `# graftlint: disable=RULE` suppressions are honored.
+  - **at runtime** (`runtime`): a `CompileCounter` asserting
+    jit-program-count budgets, a `jax.transfer_guard`-based
+    device-residency mode with an allow-listed `host_read` boundary, and
+    an instrumented-lock audit that records real acquisition orders and
+    cross-checks them against the static lock graph.
+
+CLI: ``python -m deeplearning4j_tpu.analysis.lint`` (or the ``graftlint``
+console script). Docs: ``docs/static_analysis.md``.
+"""
+from .core import Baseline, Finding, Linter, ModuleInfo, Rule, load_modules
+from .runtime import (CompileCounter, LockAuditor, crosscheck_lock_order,
+                      device_index, device_residency, host_read, lock_audit)
+
+__all__ = [
+    "Baseline", "Finding", "Linter", "ModuleInfo", "Rule", "load_modules",
+    "CompileCounter", "LockAuditor", "crosscheck_lock_order",
+    "device_index", "device_residency", "host_read", "lock_audit",
+    "all_rules", "jax_rule_pack", "concurrency_rule_pack",
+]
+
+
+def jax_rule_pack():
+    from .jax_rules import RULES
+    return [r() for r in RULES]
+
+
+def concurrency_rule_pack():
+    from .concurrency_rules import RULES
+    return [r() for r in RULES]
+
+
+def all_rules():
+    return jax_rule_pack() + concurrency_rule_pack()

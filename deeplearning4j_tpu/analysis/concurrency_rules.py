@@ -1,0 +1,577 @@
+"""graftlint concurrency rule pack: lock-order and atomicity rules.
+
+The model (AST only, `with`-statement discipline — the only locking idiom
+this codebase uses):
+
+  - **lock definitions**: ``self.<attr> = threading.Lock()/RLock()/
+    Condition()`` inside a class, or a module-level ``NAME = threading.
+    Lock()``. Identity: ``<relpath>:<Class>.<attr>``; the definition's
+    (file, line) doubles as the join key for the *runtime* instrumented-
+    lock audit (analysis.runtime), which names real locks by their
+    allocation site.
+  - **acquisition order**: walking each function with a stack of held
+    locks, a nested ``with`` on another known lock adds a directed edge
+    held -> acquired. One level of inter-procedural propagation: a call
+    made while holding a lock adds edges to every lock the (heuristically
+    resolved) callee acquires directly — `self.m()` resolves within the
+    class; `obj.m()` resolves by method name across all analyzed classes
+    (over-approximate on purpose: false edges only matter if they close a
+    cycle, and a cycle through a never-alias pair is worth a look anyway).
+
+Rules:
+  CC001 lock-order-cycle          cycle in the global acquisition graph
+  CC002 blocking-call-under-lock  unbounded queue.get()/join()/result()/
+                                  foreign .wait() while holding a lock
+  CC003 condition-wait-no-loop    Condition.wait not re-checked in a
+                                  while-predicate loop
+  CC004 torn-lock-guarded-read    attr written under a lock but read
+                                  outside it in a method that also
+                                  acquires that lock (torn snapshot)
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo, Rule
+from .core import dotted_name as _dotted
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_BLOCKING_METHODS = {"get", "join", "result", "wait", "acquire", "put"}
+_MUTATORS = {"append", "extend", "insert", "pop", "remove", "clear",
+             "update", "setdefault", "add", "discard", "popleft",
+             "appendleft"}
+
+
+@dataclass
+class LockDef:
+    lock_id: str          # "inference/metrics.py:Histogram._lock"
+    kind: str             # Lock / RLock / Condition / ...
+    path: str
+    line: int
+
+
+@dataclass
+class LockGraph:
+    """Static lock universe + acquisition-order edges for a file set."""
+
+    locks: Dict[str, LockDef] = field(default_factory=dict)
+    # (held_id, acquired_id) -> (path, line) of one witness site
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = field(
+        default_factory=dict)
+
+    @property
+    def edge_set(self) -> Set[Tuple[str, str]]:
+        return set(self.edges)
+
+    def by_site(self) -> Dict[Tuple[str, int], str]:
+        """(path, line) of the definition -> lock id; the join key the
+        runtime lock audit uses to map real locks back to this graph."""
+        return {(d.path, d.line): d.lock_id for d in self.locks.values()}
+
+
+def find_cycle(edges: Set[Tuple[str, str]]) -> Optional[List[str]]:
+    """One representative cycle ([a, b, ..., a]) in a directed graph, or
+    None. Iterative DFS with colors; self-edges are ignored (RLock
+    re-entry is legal)."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        if a != b:
+            adj.setdefault(a, []).append(b)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in
+             set(adj) | {b for vs in adj.values() for b in vs}}
+    for root in sorted(color):
+        if color[root] != WHITE:
+            continue
+        stack = [(root, iter(adj.get(root, [])))]
+        color[root] = GRAY
+        path = [root]
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if color.get(nxt, WHITE) == GRAY:
+                    return path[path.index(nxt):] + [nxt]
+                if color.get(nxt, WHITE) == WHITE:
+                    color[nxt] = GRAY
+                    stack.append((nxt, iter(adj.get(nxt, []))))
+                    path.append(nxt)
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                stack.pop()
+                path.pop()
+    return None
+
+
+class _ClassLocks(ast.NodeVisitor):
+    """Pass 1 over one module: lock definitions per class (and module),
+    plus, per method, the locks it acquires directly via `with`."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        # class name (or "" for module level) -> attr/name -> LockDef
+        self.defs: Dict[str, Dict[str, LockDef]] = {}
+        self._collect()
+
+    def _lock_kind(self, value) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        d = _dotted(value.func)
+        last = d.split(".")[-1] if d else ""
+        if last in _LOCK_CTORS:
+            return last
+        return None
+
+    def _collect(self) -> None:
+        rel = self.mod.relpath
+        for node in self.mod.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = self._lock_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.defs.setdefault("", {})[t.id] = LockDef(
+                                f"{rel}:{t.id}", kind, rel, node.lineno)
+        for cls_node in [n for n in self.mod.tree.body
+                         if isinstance(n, ast.ClassDef)]:
+            for sub in ast.walk(cls_node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                kind = self._lock_kind(sub.value)
+                if not kind:
+                    continue
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        self.defs.setdefault(cls_node.name, {})[t.attr] = \
+                            LockDef(f"{rel}:{cls_node.name}.{t.attr}",
+                                    kind, rel, sub.lineno)
+
+
+def _lock_of_withitem(item: ast.withitem, cls: str,
+                      classes: Dict[str, Dict[str, LockDef]]
+                      ) -> Optional[LockDef]:
+    ctx = item.context_expr
+    if isinstance(ctx, ast.Attribute) and isinstance(ctx.value, ast.Name) \
+            and ctx.value.id == "self":
+        return classes.get(cls, {}).get(ctx.attr)
+    if isinstance(ctx, ast.Name):
+        return classes.get("", {}).get(ctx.id)
+    return None
+
+
+class _Acquisitions:
+    """Pass 2 over one module: walk every function tracking the held-lock
+    stack; records direct nested edges, calls made under a lock, per-
+    method direct acquisitions, and the raw events the leaf rules need."""
+
+    def __init__(self, mod: ModuleInfo, classes: Dict[str, Dict[str, LockDef]]):
+        self.mod = mod
+        self.classes = classes
+        self.direct_edges: List[Tuple[LockDef, LockDef, ast.AST]] = []
+        # (held locks tuple, enclosing class, call node)
+        self.calls_under_lock: List[Tuple[Tuple[LockDef, ...], str,
+                                          ast.Call]] = []
+        # (class, method) -> locks acquired directly in its body
+        self.method_locks: Dict[Tuple[str, str], Set[str]] = {}
+        self._lockdefs_by_id: Dict[str, LockDef] = {}
+        # wait() events: (lockdef, call node, has while ancestor)
+        self.waits: List[Tuple[LockDef, ast.Call, bool]] = []
+        self._walk_module()
+
+    def _walk_module(self) -> None:
+        for node in self.mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._walk_fn(item, node.name, item.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_fn(node, "", node.name)
+
+    def _walk_fn(self, fn, cls: str, method: str) -> None:
+        held: List[LockDef] = []
+        loops = 0
+        mkey = (cls, method)
+        self.method_locks.setdefault(mkey, set())
+
+        def visit(node):
+            nonlocal loops
+            if isinstance(node, ast.With):
+                acquired = []
+                for item in node.items:
+                    ld = _lock_of_withitem(item, cls, self.classes)
+                    if ld is not None:
+                        self._lockdefs_by_id[ld.lock_id] = ld
+                        self.method_locks[mkey].add(ld.lock_id)
+                        for h in held:
+                            self.direct_edges.append((h, ld, node))
+                        held.append(ld)
+                        acquired.append(ld)
+                for child in node.body:
+                    visit(child)
+                for ld in acquired:
+                    held.remove(ld)
+                return
+            if isinstance(node, (ast.While, ast.For)):
+                loops += 1
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                loops -= 1
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # nested defs run later, under their own locks
+            if isinstance(node, ast.Call):
+                if held:
+                    self.calls_under_lock.append((tuple(held), cls, node))
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "wait":
+                    target = node.func.value
+                    if isinstance(target, ast.Attribute) and \
+                            isinstance(target.value, ast.Name) and \
+                            target.value.id == "self":
+                        ld = self.classes.get(cls, {}).get(target.attr)
+                        if ld is not None and ld.kind == "Condition":
+                            self.waits.append((ld, node, loops > 0))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.body:
+            visit(stmt)
+
+
+class _ConcInfo:
+    """Whole-project pass shared by every rule in the pack (computed once
+    per module list and cached on the first module)."""
+
+    def __init__(self, mods: Sequence[ModuleInfo]):
+        self.mods = list(mods)
+        self.classes_by_mod: Dict[str, Dict[str, Dict[str, LockDef]]] = {}
+        self.acq_by_mod: Dict[str, _Acquisitions] = {}
+        for m in mods:
+            cl = _ClassLocks(m)
+            self.classes_by_mod[m.relpath] = cl.defs
+            self.acq_by_mod[m.relpath] = _Acquisitions(m, cl.defs)
+        # global method-name -> lock ids it acquires directly (for the
+        # heuristic obj.m() resolution)
+        self.locks_by_method_name: Dict[str, Set[str]] = {}
+        # exact (class, method) -> lock ids
+        self.locks_by_class_method: Dict[Tuple[str, str], Set[str]] = {}
+        self.lockdef_by_id: Dict[str, LockDef] = {}
+        for rel, acq in self.acq_by_mod.items():
+            for (cls, meth), lock_ids in acq.method_locks.items():
+                if not lock_ids:
+                    continue
+                self.locks_by_method_name.setdefault(meth, set()).update(
+                    lock_ids)
+                self.locks_by_class_method.setdefault(
+                    (cls, meth), set()).update(lock_ids)
+            self.lockdef_by_id.update(acq._lockdefs_by_id)
+        for rel, classes in self.classes_by_mod.items():
+            for attrs in classes.values():
+                for ld in attrs.values():
+                    self.lockdef_by_id[ld.lock_id] = ld
+
+    def graph(self) -> LockGraph:
+        g = LockGraph()
+        for ld in self.lockdef_by_id.values():
+            g.locks[ld.lock_id] = ld
+        for rel, acq in self.acq_by_mod.items():
+            for held, ld, node in acq.direct_edges:
+                key = (held.lock_id, ld.lock_id)
+                g.edges.setdefault(key, (rel, node.lineno))
+            for held, cls, call in acq.calls_under_lock:
+                callee_locks = self._resolve_callee_locks(cls, call)
+                for h in held:
+                    for lid in callee_locks:
+                        if lid != h.lock_id:
+                            g.edges.setdefault((h.lock_id, lid),
+                                               (rel, call.lineno))
+        return g
+
+    def _resolve_callee_locks(self, cls: str, call: ast.Call) -> Set[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                return self.locks_by_class_method.get((cls, name), set())
+            # obj.m(): any analyzed class with a lock-acquiring method m.
+            # Dunder-ish / ubiquitous names are skipped: matching every
+            # dict.get() to a lock-taking get() would drown the graph.
+            if name in {"get", "put", "append", "pop", "update", "items",
+                        "keys", "values", "join", "wait", "notify",
+                        "notify_all", "acquire", "release", "read",
+                        "write", "close", "send", "recv"}:
+                return set()
+            return self.locks_by_method_name.get(name, set())
+        if isinstance(func, ast.Name):
+            return self.locks_by_class_method.get(("", func.id), set())
+        return set()
+
+
+def _conc_info(mods: Sequence[ModuleInfo]) -> _ConcInfo:
+    if not mods:
+        return _ConcInfo([])
+    anchor = mods[0]
+    cached = getattr(anchor, "_graftlint_conc_info", None)
+    if cached is not None and len(cached.mods) == len(mods):
+        return cached
+    info = _ConcInfo(mods)
+    anchor._graftlint_conc_info = info
+    return info
+
+
+def build_lock_graph(mods: Sequence[ModuleInfo]) -> LockGraph:
+    """Public entry: the static lock graph for a module set (also used by
+    the runtime instrumented-lock cross-check)."""
+    return _conc_info(mods).graph()
+
+
+class LockOrderCycle(Rule):
+    id = "CC001"
+    name = "lock-order-cycle"
+    description = ("cycle in the cross-module lock-acquisition-order "
+                   "graph: two threads taking the locks in opposite "
+                   "order deadlock")
+
+    def check_project(self, mods: Sequence[ModuleInfo]) -> List[Finding]:
+        graph = _conc_info(mods).graph()
+        cycle = find_cycle(graph.edge_set)
+        if cycle is None:
+            return []
+        # anchor the finding at the witness site of the cycle's first edge
+        path, line = graph.edges.get((cycle[0], cycle[1]), ("", 1))
+        mod = next((m for m in mods if m.relpath == path), None)
+        pretty = " -> ".join(cycle)
+        msg = (f"lock acquisition order forms a cycle: {pretty}; two "
+               "threads traversing it from different entry points "
+               "deadlock — impose a single global order")
+        if mod is None:
+            return [Finding(rule=self.id, path=path or "<project>",
+                            line=line, col=0, message=msg)]
+        f = Finding(rule=self.id, path=path, line=line, col=0, message=msg,
+                    snippet=mod.line_text(line).strip())
+        return [f]
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if any(k.arg in ("timeout", "timeout_s", "timeout_ms") and
+           not (isinstance(k.value, ast.Constant) and k.value.value is None)
+           for k in call.keywords):
+        return True
+    # positional timeouts: get(block, timeout), join(timeout),
+    # wait(timeout), result(timeout), acquire(blocking, timeout)
+    name = call.func.attr if isinstance(call.func, ast.Attribute) else ""
+    if name in {"join", "wait", "result"} and call.args:
+        return not (isinstance(call.args[0], ast.Constant)
+                    and call.args[0].value is None)
+    if name == "get" and len(call.args) >= 2:
+        return True
+    if name == "get" and any(k.arg == "block" and
+                             isinstance(k.value, ast.Constant) and
+                             k.value.value is False
+                             for k in call.keywords):
+        return True
+    return False
+
+
+class BlockingCallUnderLock(Rule):
+    id = "CC002"
+    name = "blocking-call-under-lock"
+    description = ("unbounded blocking call (queue.get()/Thread.join()/"
+                   "future.result()/foreign wait()) while holding a lock "
+                   "stalls every other thread needing that lock")
+
+    def check_project(self, mods: Sequence[ModuleInfo]) -> List[Finding]:
+        info = _conc_info(mods)
+        out = []
+        for m in mods:
+            acq = info.acq_by_mod[m.relpath]
+            for held, cls, call in acq.calls_under_lock:
+                func = call.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                name = func.attr
+                if name not in _BLOCKING_METHODS or _has_timeout(call):
+                    continue
+                if name == "put" and not any(
+                        k.arg == "block" and
+                        isinstance(k.value, ast.Constant) and
+                        k.value.value is True for k in call.keywords):
+                    # put() is usually unbounded (never blocks) and
+                    # put(block=False) raises queue.Full instead of
+                    # blocking; only the explicit block=True form is an
+                    # unbounded wait
+                    continue
+                if name == "acquire":
+                    continue  # ordering is CC001's job, not blocking
+                if name == "get" and call.args:
+                    # queue.get takes no positional key; get(x[, d]) is
+                    # dict/registry lookup, not a blocking dequeue
+                    continue
+                # wait()/notify on the HELD condition is the one legal
+                # blocking call under a lock (it releases it)
+                target = func.value
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self" and \
+                        any(h.lock_id.endswith(f".{target.attr}")
+                            for h in held):
+                    continue
+                if isinstance(target, ast.Name) and any(
+                        h.lock_id.endswith(f":{target.id}") for h in held):
+                    continue
+                held_names = ", ".join(h.lock_id for h in held)
+                out.append(m.finding(
+                    self.id, call,
+                    f".{name}() with no timeout while holding "
+                    f"[{held_names}]: if the producer needs that lock "
+                    "to make progress this deadlocks, and at best it "
+                    "serializes every waiter — drop the lock first or "
+                    "bound the wait"))
+        return out
+
+
+class ConditionWaitNoLoop(Rule):
+    id = "CC003"
+    name = "condition-wait-no-loop"
+    description = ("Condition.wait() outside a while-predicate loop: "
+                   "spurious wakeups and stolen notifications make the "
+                   "woken thread proceed on a false premise")
+
+    def check_project(self, mods: Sequence[ModuleInfo]) -> List[Finding]:
+        info = _conc_info(mods)
+        out = []
+        for m in mods:
+            for ld, call, in_loop in info.acq_by_mod[m.relpath].waits:
+                if not in_loop:
+                    out.append(m.finding(
+                        self.id, call,
+                        f"{ld.lock_id}.wait() is not re-checked in a "
+                        "while loop: wakeups are advisory (spurious "
+                        "wakeups, notify races) — wrap it as `while not "
+                        "<predicate>: cond.wait()`"))
+        return out
+
+
+class TornLockGuardedRead(Rule):
+    id = "CC004"
+    name = "torn-lock-guarded-read"
+    description = ("attribute written under a lock but read outside it in "
+                   "a method that also takes that lock: the method sees a "
+                   "torn snapshot (classic read-modify-write race)")
+
+    _EXEMPT_METHODS = {"__init__", "__new__"}
+
+    def check_project(self, mods: Sequence[ModuleInfo]) -> List[Finding]:
+        info = _conc_info(mods)  # shares the one _ClassLocks pass
+        out = []
+        for m in mods:
+            out.extend(self._check_module(
+                m, info.classes_by_mod[m.relpath]))
+        return out
+
+    def _check_module(self, mod: ModuleInfo, classes) -> List[Finding]:
+        out = []
+        for cls_node in [n for n in mod.tree.body
+                         if isinstance(n, ast.ClassDef)]:
+            lock_attrs = set(classes.get(cls_node.name, {}))
+            if not lock_attrs:
+                continue
+            out.extend(self._check_class(mod, cls_node, lock_attrs,
+                                         classes))
+        return out
+
+    def _check_class(self, mod, cls_node, lock_attrs, classes):
+        written_under_lock: Set[str] = set()
+        # (attr, method) -> first unlocked access node, for methods that
+        # DO acquire a lock somewhere (fully lock-free methods follow a
+        # different discipline — single-writer or immutable — and flagging
+        # them would bury the real races)
+        unlocked_access: Dict[Tuple[str, str], ast.AST] = {}
+
+        for item in cls_node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            method = item.name
+            acquires_any = False
+            accesses: List[Tuple[str, bool, ast.AST, bool]] = []
+
+            def visit(node, under):
+                nonlocal acquires_any
+                if isinstance(node, ast.With):
+                    got = any(
+                        _lock_of_withitem(i, cls_node.name, classes)
+                        for i in node.items)
+                    if got:
+                        acquires_any = True
+                    for child in node.body:
+                        visit(child, under or got)
+                    return
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    return
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "self" and \
+                        node.attr not in lock_attrs:
+                    is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+                    accesses.append((node.attr, is_store, node, under))
+                # self.x[i] = v parses x as a Load inside a stored
+                # Subscript; self.x.append(v) is a mutating method call.
+                # Both are writes for torn-read purposes.
+                if isinstance(node, ast.Subscript) and \
+                        isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                        isinstance(node.value, ast.Attribute) and \
+                        isinstance(node.value.value, ast.Name) and \
+                        node.value.value.id == "self":
+                    accesses.append((node.value.attr, True, node, under))
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATORS and \
+                        isinstance(node.func.value, ast.Attribute) and \
+                        isinstance(node.func.value.value, ast.Name) and \
+                        node.func.value.value.id == "self":
+                    accesses.append((node.func.value.attr, True, node,
+                                     under))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, under)
+
+            for stmt in item.body:
+                visit(stmt, False)
+            for attr, is_store, node, under in accesses:
+                if under and is_store:
+                    written_under_lock.add(attr)
+                # subscript stores parse the attr as Load; treat any
+                # access inside an Assign-target... keep it simple: a
+                # Load that feeds `self.x[i] = v` still reads self.x.
+                if not under and method not in self._EXEMPT_METHODS \
+                        and acquires_any:
+                    unlocked_access.setdefault((attr, method), node)
+
+        out = []
+        reported: Set[Tuple[str, str]] = set()
+        for (attr, method), node in sorted(
+                unlocked_access.items(),
+                key=lambda kv: getattr(kv[1], "lineno", 0)):
+            if attr in written_under_lock and (attr, method) not in reported:
+                reported.add((attr, method))
+                out.append(mod.finding(
+                    self.id, node,
+                    f"self.{attr} is written under a lock elsewhere in "
+                    f"{cls_node.name} but accessed lock-free here (a "
+                    "method that does take the lock): concurrent "
+                    "mutation gives this method a torn view — widen the "
+                    "locked region or copy state under the lock"))
+        return out
+
+
+RULES = [LockOrderCycle, BlockingCallUnderLock, ConditionWaitNoLoop,
+         TornLockGuardedRead]

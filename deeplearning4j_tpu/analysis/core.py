@@ -1,0 +1,275 @@
+"""graftlint core: findings, rule SPI, suppressions, baseline diffing.
+
+Design (the pyflakes/ruff shape, rebuilt small):
+
+  - a **ModuleInfo** per analyzed file — parsed AST + source lines, with
+    the repo-relative path normalized so fingerprints are stable across
+    checkouts;
+  - a **Rule** SPI with two hooks: ``check_module`` (per-file rules) and
+    ``check_project`` (whole-program rules like the lock-order graph,
+    which must see every module before judging any);
+  - **suppressions**: a trailing ``# graftlint: disable=JG001,CC002``
+    (or bare ``# graftlint: disable``) on the *flagged line* silences it —
+    suppressions are grep-able, reviewed in diffs, and rule-scoped;
+  - a **Baseline**: the committed debt ledger. A finding's fingerprint is
+    (rule, path, enclosing symbol, normalized source text) — deliberately
+    *not* the line number, so unrelated edits shifting lines don't churn
+    the baseline. CI fails only when a fingerprint's count exceeds the
+    committed count; fixed findings show up as retirable baseline entries.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable(?:=([A-Za-z0-9_,\s]+))?")
+_PKG = "deeplearning4j_tpu"
+
+
+def _relpath(path: Path) -> str:
+    """Stable repo-relative posix path: anchored at the package directory
+    when the file lives under it, else the last two components (fixture
+    files in tmp dirs — keeping the parent dir makes same-basename files
+    from different dirs distinct). Keeps baseline fingerprints
+    checkout-independent."""
+    parts = path.resolve().parts
+    if _PKG in parts:
+        return "/".join(parts[parts.index(_PKG):])
+    return "/".join(parts[-2:]) if len(parts) >= 2 else path.name
+
+
+def dotted_name(node) -> str:
+    """'jax.lax.scan' for an Attribute/Name chain, '' otherwise (the
+    chain stops at anything that isn't a plain name, e.g. a Call
+    receiver). Shared by both rule packs."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str = ""  # enclosing function/class qualname
+    snippet: str = ""  # stripped source of the flagged line
+
+    @property
+    def fingerprint(self) -> str:
+        text = re.sub(r"\s+", " ", self.snippet).strip()
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{self.symbol}|{text}".encode()
+        ).hexdigest()[:16]
+        return f"{self.rule}:{h}"
+
+    def format(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message}{sym}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "symbol": self.symbol, "snippet": self.snippet,
+                "fingerprint": self.fingerprint}
+
+
+class ModuleInfo:
+    """One parsed source file plus the derived indexes rules share."""
+
+    def __init__(self, path: Path, source: Optional[str] = None):
+        self.path = path
+        self.relpath = _relpath(path)
+        self.source = (path.read_text() if source is None else source)
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        self._qualnames: Dict[int, str] = {}
+        self._index_qualnames()
+
+    def _index_qualnames(self) -> None:
+        """Map every function/class def node (by id) to its dotted
+        qualname, so findings can name their enclosing symbol."""
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    qn = f"{prefix}.{child.name}" if prefix else child.name
+                    self._qualnames[id(child)] = qn
+                    walk(child, qn)
+                else:
+                    walk(child, prefix)
+        walk(self.tree, "")
+
+    def qualname(self, node) -> str:
+        return self._qualnames.get(id(node), "")
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed_rules(self, lineno: int) -> Optional[set]:
+        """Rules disabled on this line; empty set means *all* rules."""
+        m = _SUPPRESS_RE.search(self.line_text(lineno))
+        if not m:
+            return None
+        if m.group(1) is None:
+            return set()
+        return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        f = Finding(rule=rule, path=self.relpath, line=line, col=col,
+                    message=message, symbol=self._enclosing(node),
+                    snippet=self.line_text(line).strip())
+        # the originating module rides along (not serialized) so the
+        # suppression check never has to resolve a possibly-ambiguous
+        # path back to a ModuleInfo
+        f._mod = self
+        return f
+
+    def _enclosing(self, node) -> str:
+        """Qualname of the innermost def/class containing ``node``."""
+        target_line = getattr(node, "lineno", None)
+        if target_line is None:
+            return ""
+        best, best_span = "", None
+
+        def walk(parent):
+            nonlocal best, best_span
+            for child in ast.iter_child_nodes(parent):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    lo = child.lineno
+                    hi = getattr(child, "end_lineno", lo)
+                    if lo <= target_line <= hi:
+                        span = hi - lo
+                        if best_span is None or span <= best_span:
+                            best, best_span = self.qualname(child), span
+                walk(child)
+        walk(self.tree)
+        return best
+
+
+class Rule:
+    """Base rule. ``id`` like JG001/CC001; subclasses override one hook."""
+
+    id = "XX000"
+    name = "unnamed"
+    description = ""
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        return []
+
+    def check_project(self, mods: Sequence[ModuleInfo]) -> List[Finding]:
+        return []
+
+
+def load_modules(paths: Iterable[Path]) -> Tuple[List[ModuleInfo], List[str]]:
+    """Collect .py files under the given files/dirs into ModuleInfos.
+    Unparseable files are reported, not fatal (the linter must never be
+    the thing that breaks on a syntax error pytest would catch anyway)."""
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    mods, errors = [], []
+    for f in files:
+        try:
+            mods.append(ModuleInfo(f))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(f"{f}: {e}")
+    return mods, errors
+
+
+class Linter:
+    def __init__(self, rules: Sequence[Rule]):
+        self.rules = list(rules)
+
+    def run(self, paths: Iterable[Path]) -> Tuple[List[Finding], List[str]]:
+        mods, errors = load_modules(paths)
+        return self.run_modules(mods), errors
+
+    def run_modules(self, mods: Sequence[ModuleInfo]) -> List[Finding]:
+        by_path = {m.relpath: m for m in mods}
+        findings: List[Finding] = []
+        for rule in self.rules:
+            for m in mods:
+                findings.extend(rule.check_module(m))
+            findings.extend(rule.check_project(mods))
+        kept = []
+        for f in findings:
+            mod = getattr(f, "_mod", None) or by_path.get(f.path)
+            if mod is not None:
+                sup = mod.suppressed_rules(f.line)
+                if sup is not None and (not sup or f.rule in sup):
+                    continue
+            kept.append(f)
+        kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return kept
+
+
+@dataclass
+class Baseline:
+    """Committed ledger of accepted findings, keyed by fingerprint."""
+
+    entries: Dict[str, dict] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        path = Path(path)
+        if not path.exists():
+            return cls()
+        data = json.loads(path.read_text())
+        return cls(entries=data.get("findings", {}))
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding]) -> "Baseline":
+        entries: Dict[str, dict] = {}
+        for f in findings:
+            e = entries.setdefault(f.fingerprint, {
+                "rule": f.rule, "path": f.path, "symbol": f.symbol,
+                "message": f.message, "snippet": f.snippet, "count": 0})
+            e["count"] += 1
+        return cls(entries=entries)
+
+    def save(self, path: Path) -> None:
+        body = {"version": 1,
+                "comment": "graftlint accepted-findings ledger; regenerate "
+                           "with: python -m deeplearning4j_tpu.analysis.lint "
+                           "--update-baseline",
+                "findings": dict(sorted(self.entries.items()))}
+        Path(path).write_text(json.dumps(body, indent=1, sort_keys=False)
+                              + "\n")
+
+    def diff(self, findings: Sequence[Finding]
+             ) -> Tuple[List[Finding], List[str]]:
+        """(new findings beyond the baselined counts, fingerprints whose
+        debt shrank/vanished — retirable baseline entries)."""
+        seen: Dict[str, int] = {}
+        new: List[Finding] = []
+        for f in findings:
+            seen[f.fingerprint] = seen.get(f.fingerprint, 0) + 1
+            budget = self.entries.get(f.fingerprint, {}).get("count", 0)
+            if seen[f.fingerprint] > budget:
+                new.append(f)
+        fixed = [fp for fp, e in self.entries.items()
+                 if seen.get(fp, 0) < e.get("count", 0)]
+        return new, sorted(fixed)

@@ -1,0 +1,128 @@
+"""graftlint CLI.
+
+    python -m deeplearning4j_tpu.analysis.lint [paths...]
+        [--format text|json] [--baseline FILE] [--update-baseline]
+        [--no-baseline] [--rules JG001,CC004,...]
+
+Defaults: paths = the installed ``deeplearning4j_tpu`` package directory,
+baseline = the committed ``analysis/baseline.json``. Exit codes: 0 clean
+(every finding baselined or none), 1 new violations (or parse errors),
+2 usage error. ``--update-baseline`` rewrites the ledger from the current
+findings and exits 0 — the reviewed-diff workflow for accepting debt.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from . import all_rules
+from .core import Baseline, Linter
+
+_DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+_DEFAULT_TARGET = Path(__file__).resolve().parent.parent  # the package
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX-aware static analyzer: recompile discipline, "
+                    "host-sync hygiene, lock ordering")
+    p.add_argument("paths", nargs="*", type=Path,
+                   default=None, help="files/dirs to lint "
+                   "(default: the deeplearning4j_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", type=Path, default=None,
+                   help=f"baseline ledger (default: {_DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignore the ledger")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the ledger from current findings")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    return p
+
+
+def run_lint(paths: Optional[Sequence[Path]] = None,
+             rules: Optional[Sequence[str]] = None):
+    """(findings, errors) over the given paths — the programmatic entry
+    the CI gate test uses. Unknown rule ids raise (a typo'd --rules must
+    not produce a vacuously clean run)."""
+    selected = all_rules()
+    if rules:
+        wanted = {r.strip() for r in rules}
+        known = {r.id for r in selected}
+        unknown = sorted(wanted - known)
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s) {unknown}; known: {sorted(known)}")
+        selected = [r for r in selected if r.id in wanted]
+    linter = Linter(selected)
+    return linter.run(list(paths) if paths else [_DEFAULT_TARGET])
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.update_baseline and args.no_baseline:
+        print("--update-baseline and --no-baseline conflict",
+              file=sys.stderr)
+        return 2
+    if args.update_baseline and args.rules:
+        # a rules-subset run sees a subset of findings; rewriting the
+        # ledger from it would silently retire every other rule's entries
+        print("--update-baseline requires a full-rule run (drop --rules)",
+              file=sys.stderr)
+        return 2
+    if args.update_baseline and args.paths and args.baseline is None:
+        print("--update-baseline over a custom path set would overwrite "
+              "the default package ledger with partial findings; pass an "
+              "explicit --baseline for it", file=sys.stderr)
+        return 2
+    rules = args.rules.split(",") if args.rules else None
+    paths = args.paths if args.paths else None
+    try:
+        findings, errors = run_lint(paths, rules)
+    except ValueError as e:  # typo'd --rules: refuse, don't pass cleanly
+        print(str(e), file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or _DEFAULT_BASELINE
+    if args.update_baseline:
+        Baseline.from_findings(findings).save(baseline_path)
+        print(f"baseline updated: {len(findings)} finding(s) -> "
+              f"{baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        baseline = Baseline()
+    else:
+        baseline = Baseline.load(baseline_path)
+    new, fixed = baseline.diff(findings)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in new],
+            "fixed_fingerprints": fixed,
+            "errors": errors,
+            "summary": {"total": len(findings), "new": len(new),
+                        "baselined": len(findings) - len(new),
+                        "fixed": len(fixed)},
+        }, indent=1))
+    else:
+        for f in (findings if args.no_baseline else new):
+            print(f.format())
+        for e in errors:
+            print(f"error: {e}", file=sys.stderr)
+        if fixed:
+            print(f"note: {len(fixed)} baselined finding(s) no longer "
+                  "fire — regenerate the baseline to retire them")
+        print(f"{len(findings)} finding(s): {len(findings) - len(new)} "
+              f"baselined, {len(new)} new")
+    return 1 if (new or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

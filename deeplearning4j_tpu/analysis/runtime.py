@@ -1,0 +1,360 @@
+"""Runtime audit harness: the dynamic half of graftlint.
+
+Static rules state the invariants; these helpers make test runs *prove*
+them on real executions:
+
+  - :func:`host_read` / :func:`device_index` — the sanctioned
+    device<->host boundaries for hot-loop code. ``host_read`` is the ONE
+    place the decode/prefill scheduler is allowed to block on a
+    device->host sync (the sampled-token readback); it re-allows
+    transfers locally so the surrounding code can run under
+    ``jax.transfer_guard("disallow")``. ``device_index`` ships a host
+    scalar to device as an explicit 1-element int32 array (scalar feeds
+    are *implicit* transfers under the guard; 1-d np arrays are
+    explicit).
+  - :func:`device_residency` — process-wide ``jax.transfer_guard`` fixture
+    for tests: any implicit transfer anywhere (every thread) raises.
+  - :class:`CompileCounter` — asserts jit-program-count budgets over
+    named jitted callables (the generalized recompile guard; budgets for
+    the decode scheduler come from :meth:`CompileCounter.for_scheduler`).
+  - :func:`lock_audit` / :class:`LockAuditor` — instruments
+    ``threading.Lock/RLock/Condition`` so real acquisition orders are
+    recorded (edges: lock A held while acquiring lock B, keyed by each
+    lock's allocation site), and :func:`crosscheck_lock_order` joins the
+    observed edges against the static lock graph
+    (``concurrency_rules.build_lock_graph``) and rejects any combined
+    cycle.
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# NOTE: no module-level import of the AST rule machinery — the serving hot
+# path imports this module for host_read/device_index, and must not drag
+# the linter in with it; crosscheck_lock_order imports lazily.
+
+_PKG = "deeplearning4j_tpu"
+
+
+# -- sanctioned transfer boundaries ---------------------------------------
+def host_read(x) -> np.ndarray:
+    """Blocking device->host read, declared. Hot-loop code must funnel its
+    (few, deliberate) host reads through here: graftlint rule JG006 flags
+    any other sync in scheduler-loop code, and under
+    ``jax.transfer_guard("disallow")`` this is the allow-listed boundary
+    that still passes."""
+    with jax.transfer_guard("allow"):
+        return np.asarray(x)
+
+
+def device_index(v: int) -> jax.Array:
+    """A host scalar as an EXPLICIT host->device transfer: 1-element
+    int32 array (``jnp.asarray`` of a >=1-d numpy array is explicit under
+    the transfer guard; bare Python/numpy scalars are implicit and fail
+    under "disallow"). Traced consumers index ``[0]``."""
+    return jnp.asarray(np.asarray([v], np.int32))
+
+
+@contextlib.contextmanager
+def device_residency(level: str = "disallow"):
+    """Process-wide transfer-guard fixture: while active, implicit
+    host<->device transfers raise on EVERY thread (the scheduler/dispatch
+    threads included — jax.transfer_guard's context-manager form is
+    thread-local, which would silently skip them)."""
+    try:
+        prev = jax.config.jax_transfer_guard
+    except AttributeError:  # much older jax: nothing to restore
+        prev = None
+    jax.config.update("jax_transfer_guard", level)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_transfer_guard",
+                          prev if prev is not None else "allow")
+
+
+# -- compile budgets -------------------------------------------------------
+class CompileCounter:
+    """Asserts jit-program-count budgets over named jitted callables.
+
+    Counts are deltas against each callable's compiled-program cache size
+    at ``track`` time, so pre-warmed functions start at 0. The budget is
+    the *invariant*, not an observation: decode must stay at exactly one
+    program no matter the request mix, prefill at one per chunk bucket.
+    """
+
+    def __init__(self):
+        self._tracked: Dict[str, Tuple[object, Optional[int], int]] = {}
+
+    @staticmethod
+    def _cache_size(jitted) -> int:
+        size = getattr(jitted, "_cache_size", None)
+        if callable(size):
+            return int(size())
+        raise TypeError(
+            f"{jitted!r} exposes no _cache_size(); pass a jax.jit result")
+
+    def track(self, name: str, jitted, budget: Optional[int] = None
+              ) -> "CompileCounter":
+        self._tracked[name] = (jitted, budget, self._cache_size(jitted))
+        return self
+
+    def count(self, name: str) -> int:
+        jitted, _, base = self._tracked[name]
+        return self._cache_size(jitted) - base
+
+    def counts(self) -> Dict[str, int]:
+        return {name: self.count(name) for name in self._tracked}
+
+    def check(self) -> List[str]:
+        out = []
+        for name, (jitted, budget, base) in self._tracked.items():
+            n = self._cache_size(jitted) - base
+            if budget is not None and n > budget:
+                out.append(
+                    f"'{name}' compiled {n} XLA program(s), budget is "
+                    f"{budget}: a shape/dtype/static-arg is varying per "
+                    "call (recompile storm)")
+        return out
+
+    def assert_within_budget(self) -> None:
+        problems = self.check()
+        if problems:
+            raise AssertionError("; ".join(problems))
+
+    @classmethod
+    def for_scheduler(cls, scheduler) -> "CompileCounter":
+        """Budgets for a DecodeScheduler: 1 decode program, <=1 prefill
+        program per pow2 chunk bucket (0 when chunking is off), 1
+        slot-reset program."""
+        c = cls()
+        c.track("decode", scheduler._jstep, budget=1)
+        c.track("prefill", scheduler._jprefill,
+                budget=len(scheduler.prefill_buckets))
+        jzero = getattr(scheduler, "_jzero", None)
+        if jzero is not None:
+            c.track("admit_reset", jzero, budget=1)
+        return c
+
+
+# -- instrumented locks ----------------------------------------------------
+def _creation_site() -> Tuple[str, int]:
+    """(relpath, line) of the frame that allocated the lock, skipping
+    stdlib threading/queue internals and this module."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        base = Path(fn).name
+        if base not in ("threading.py", "queue.py", "runtime.py") and \
+                "importlib" not in fn:
+            parts = Path(fn).parts
+            if _PKG in parts:
+                rel = "/".join(parts[parts.index(_PKG):])
+            else:  # same scheme as core._relpath so sites join cleanly
+                rel = "/".join(parts[-2:]) if len(parts) >= 2 else base
+            return rel, f.f_lineno
+        f = f.f_back
+    return "<unknown>", 0
+
+
+class LockAuditor:
+    """Collects real lock-acquisition-order edges while active.
+
+    Edges are keyed by each lock's allocation site (relpath, line) — the
+    same key the static analyzer records for ``self._x = threading.Lock()``
+    definitions, so observed orders join against the static graph
+    directly. Per-thread held stacks are thread-local; the global edge map
+    is guarded by a REAL (uninstrumented) lock created before patching.
+    """
+
+    def __init__(self):
+        self._real_lock_ctor = threading.Lock
+        self._guard = threading.Lock()
+        self._tls = threading.local()
+        # (site_a, site_b) -> count: a was held when b was acquired
+        self.edges: Dict[Tuple[Tuple[str, int], Tuple[str, int]], int] = {}
+        self.sites: Set[Tuple[str, int]] = set()
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def on_acquire(self, lock) -> None:
+        held = self._held()
+        # RLock/Condition re-entry: the lock is already ours, so locks
+        # above it on the stack were acquired AFTER it — recording
+        # (top -> lock) here would invert the true order and fabricate a
+        # deadlock cycle out of legal reentrant code
+        reentry = any(h is lock for h in held)
+        if held and not reentry and held[-1] is not lock:
+            a, b = held[-1]._graftlint_site, lock._graftlint_site
+            if a != b:
+                with self._guard:
+                    self.edges[(a, b)] = self.edges.get((a, b), 0) + 1
+        held.append(lock)
+
+    def on_release(self, lock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
+
+    def observed_edges(self) -> Set[Tuple[Tuple[str, int],
+                                          Tuple[str, int]]]:
+        with self._guard:
+            return set(self.edges)
+
+
+class _AuditedLock:
+    """Wraps a real Lock/RLock; reports acquire/release to the auditor."""
+
+    def __init__(self, auditor: LockAuditor, inner):
+        self._auditor = auditor
+        self._inner = inner
+        self._graftlint_site = _creation_site()
+        auditor.sites.add(self._graftlint_site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._auditor.on_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._auditor.on_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name):  # _at_fork_reinit and friends
+        return getattr(self._inner, name)
+
+
+class _AuditedCondition(threading.Condition):
+    """Real Condition semantics (native _release_save/_is_owned — no
+    probe-acquire noise), with acquire/release/wait reported."""
+
+    def __init__(self, auditor: LockAuditor, lock=None):
+        real = lock._inner if isinstance(lock, _AuditedLock) else lock
+        super().__init__(real)
+        self._graftlint_auditor = auditor
+        self._graftlint_site = _creation_site()
+        auditor.sites.add(self._graftlint_site)
+
+    def __enter__(self):
+        r = super().__enter__()
+        self._graftlint_auditor.on_acquire(self)
+        return r
+
+    def __exit__(self, *exc):
+        self._graftlint_auditor.on_release(self)
+        return super().__exit__(*exc)
+
+    def acquire(self, *a):
+        got = super().acquire(*a)
+        if got:
+            self._graftlint_auditor.on_acquire(self)
+        return got
+
+    def release(self):
+        self._graftlint_auditor.on_release(self)
+        super().release()
+
+    def wait(self, timeout=None):
+        # wait releases the lock while blocked: mirror that in the held
+        # stack so edges recorded by OTHER acquisitions stay truthful
+        self._graftlint_auditor.on_release(self)
+        try:
+            return super().wait(timeout)
+        finally:
+            self._graftlint_auditor.on_acquire(self)
+
+    def wait_for(self, predicate, timeout=None):
+        self._graftlint_auditor.on_release(self)
+        try:
+            return super().wait_for(predicate, timeout)
+        finally:
+            self._graftlint_auditor.on_acquire(self)
+
+
+@contextlib.contextmanager
+def lock_audit():
+    """Patch threading's lock constructors so every lock allocated inside
+    the context is instrumented; yields the LockAuditor. Locks created
+    BEFORE entry keep their real, unobserved implementations — construct
+    the objects under audit inside the context."""
+    auditor = LockAuditor()
+    real_lock, real_rlock = threading.Lock, threading.RLock
+    real_cond = threading.Condition
+
+    def make_lock():
+        return _AuditedLock(auditor, real_lock())
+
+    def make_rlock():
+        return _AuditedLock(auditor, real_rlock())
+
+    def make_cond(lock=None):
+        return _AuditedCondition(auditor, lock)
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    threading.Condition = make_cond
+    try:
+        yield auditor
+    finally:
+        threading.Lock = real_lock
+        threading.RLock = real_rlock
+        threading.Condition = real_cond
+
+
+def crosscheck_lock_order(observed_edges, graph
+                          ) -> Tuple[List[str], List[Tuple[str, str]]]:
+    """Join runtime acquisition orders against the static lock graph.
+
+    Returns (violations, unmodeled_edges): violations are combined-graph
+    cycles (an observed order contradicting the static order, or a cycle
+    the static pass alone missed); unmodeled edges are observed orders
+    between statically-known locks the AST pass didn't predict — not an
+    error (the static pass is one-level inter-procedural), but the
+    watchlist for deepening it. ``graph`` is a
+    ``concurrency_rules.LockGraph``.
+    """
+    from .concurrency_rules import find_cycle
+    site_to_id = graph.by_site()
+    mapped: Set[Tuple[str, str]] = set()
+    for a, b in observed_edges:
+        ia, ib = site_to_id.get(tuple(a)), site_to_id.get(tuple(b))
+        if ia and ib and ia != ib:
+            mapped.add((ia, ib))
+    combined = mapped | graph.edge_set
+    violations: List[str] = []
+    cycle = find_cycle(combined)
+    if cycle is not None:
+        observed_part = [e for e in zip(cycle, cycle[1:]) if e in mapped]
+        violations.append(
+            "lock-order cycle in static+observed graph: "
+            + " -> ".join(cycle)
+            + (f" (runtime-observed edges: {observed_part})"
+               if observed_part else ""))
+    unmodeled = sorted(e for e in mapped if e not in graph.edge_set)
+    return violations, unmodeled

@@ -31,6 +31,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..analysis.runtime import host_read
 from .metrics import MetricsRegistry, default_registry
 
 
@@ -274,7 +275,9 @@ class MicroBatcher:
                 padded = np.concatenate([chunk, pad], axis=0)
             else:
                 padded = chunk
-            out = np.asarray(self.forward_fn(padded))
+            # the dispatcher's ONE sanctioned device->host readback per
+            # batch: results must reach numpy to be scattered to futures
+            out = host_read(self.forward_fn(padded))
             pieces.append(out[:chunk.shape[0]])
         full = np.concatenate(pieces, axis=0) if len(pieces) > 1 else pieces[0]
         outs, off = [], 0

@@ -53,6 +53,7 @@ its slot's rows).
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -61,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.runtime import device_index, host_read
 from ..models.sampling import sample_logits
 from ..nn.layers.recurrent import (BaseRecurrentImpl,
                                    _materialize_rnn_states)
@@ -169,11 +171,20 @@ class DecodeScheduler:
     iterations but each chunked iteration holds the device longer, adding
     tail latency to resident decodes). <= 1 disables chunked prefill and
     restores token-by-token prompt feeding through the decode step.
+
+    ``transfer_guard``: device-residency audit mode. When set (e.g.
+    "disallow"), every scheduler iteration runs under that thread-local
+    ``jax.transfer_guard`` level: any *implicit* host<->device transfer in
+    the hot loop raises, proving the loop only crosses the boundary at its
+    declared points — `analysis.runtime.host_read` for the sampled-token
+    readback, `device_index`/`jnp.asarray`-of-ndarray for the token feed.
+    The tier-1 residency tests run the engine this way permanently.
     """
 
     def __init__(self, net, vocab_size: int, *, n_slots: int = 4,
                  max_queue: int = 64, prefill_chunk: int = 64,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 transfer_guard: Optional[str] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         self.net = net
@@ -191,12 +202,20 @@ class DecodeScheduler:
         self._cond = threading.Condition()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        self._transfer_guard = transfer_guard
         self._jstep = jax.jit(self._step_fn)
         # one prefill program per pow2 chunk bucket (the SAME jitted
         # callable; each distinct ids length C is its own XLA program,
         # compiled once and reused across requests — the batcher's
-        # compile-once-per-bucket discipline applied to prefill)
-        self._jprefill = jax.jit(self._prefill_fn)
+        # compile-once-per-bucket discipline applied to prefill).
+        # n_real is data-dependent (real tokens in a padded chunk) and
+        # MUST stay traced: static it would recompile per tail length,
+        # defeating the bucket discipline.
+        self._jprefill = jax.jit(self._prefill_fn)  # graftlint: disable=JG004
+        # slot admission zeroes one slot's rows in ONE fused program
+        # (eagerly tree-mapped .at[].set(0) dispatched per leaf AND fed
+        # the slot index as an implicit scalar transfer per leaf)
+        self._jzero = jax.jit(self._zero_fn)
         if self.prefill_chunk > 1:
             lo = min(_MIN_CHUNK_BUCKET, self.prefill_chunk)
             self.prefill_buckets = [b for b in pow2_buckets(self.prefill_chunk)
@@ -214,6 +233,7 @@ class DecodeScheduler:
             type(impl).__name__ == "SelfAttentionLayerImpl"
             for impl in stateful)
         self._prefill_next = 0  # round-robin over prefilling slots
+        self._emitted_this_iter = 0  # scheduler-thread-only tally
         m = self.metrics
         self._m_queue_depth = m.gauge("decode_queue_depth")
         self._m_active = m.gauge("decode_active_slots")
@@ -337,7 +357,13 @@ class DecodeScheduler:
 
         Scan path (recurrent h/c state): C single-token steps fused into
         one `lax.scan` program; padded steps keep the carried state (the
-        same mask-carry discipline the training scan uses)."""
+        same mask-carry discipline the training scan uses).
+
+        ``slot``/``n_real`` arrive as 1-element int32 arrays, not Python
+        scalars: scalar feeds are *implicit* host->device transfers that
+        the transfer-guard audit mode would reject every iteration."""
+        slot = slot[0]
+        n_real = n_real[0]
         sub = self._slice_slot(states, slot)
         if self._chunk_dense:
             x = jax.nn.one_hot(ids, self.vocab_size, dtype=self._dtype)[None]
@@ -402,15 +428,23 @@ class DecodeScheduler:
             n_real = min(n_real, bucket)
         return bucket, n_real
 
-    def _reset_slot_state(self, slot: int) -> None:
+    def _zero_fn(self, states, slot):
         """Zero one slot's rows across every state leaf (KV rows, cache
-        position, LSTM h/c) so an admitted sequence starts clean."""
+        position, LSTM h/c) so an admitted sequence starts clean. Jitted:
+        one fused device program per admission instead of one eager
+        dispatch per leaf, and no implicit scalar transfers (``slot`` is
+        a 1-element int32 array, same contract as `_prefill_fn`)."""
+        s = slot[0]
+
         def zero_row(a):
             if hasattr(a, "ndim") and a.ndim >= 1 and \
                     a.shape[0] == self.n_slots:
-                return a.at[slot].set(0)
+                return a.at[s].set(0)
             return a
-        self._states = jax.tree_util.tree_map(zero_row, self._states)
+        return jax.tree_util.tree_map(zero_row, states)
+
+    def _reset_slot_state(self, slot: int) -> None:
+        self._states = self._jzero(self._states, device_index(slot))
 
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int, *,
@@ -488,7 +522,9 @@ class DecodeScheduler:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
-        for i, seq in enumerate(self._slots):
+        # safe lock-free: the scheduler thread (the only other _slots
+        # writer) has been joined above
+        for i, seq in enumerate(self._slots):  # graftlint: disable=CC004
             if seq is not None:
                 seq.handle._finish(RuntimeError("scheduler stopped"))
                 self._slots[i] = None
@@ -524,12 +560,14 @@ class DecodeScheduler:
         """Sample one output token from a next-token distribution row;
         finish + evict on max_new_tokens or EOS. Shared by the decode step
         and the final prefill chunk (whose last-real-token distribution
-        yields the first output token)."""
+        yields the first output token). Token-count metrics are NOT
+        updated here — the loop flushes one batched `inc(n)` per
+        iteration instead of taking the counter lock once per token."""
         h = seq.handle
         tok = sample_logits(probs_row, seq.temperature, seq.top_k,
                             seq.rng, seq.top_p)
         h.tokens.append(tok)
-        self._m_tokens.inc()
+        self._emitted_this_iter += 1
         now = time.monotonic()
         if h.t_first_token is None:
             h.t_first_token = now
@@ -558,65 +596,87 @@ class DecodeScheduler:
             ids[:n_real] = seq.prompt[seq.fed:seq.fed + n_real]
             probs, self._states = self._jprefill(
                 self.net.params, self.net.variables,
-                jnp.asarray(i, jnp.int32), jnp.asarray(ids),
-                jnp.asarray(n_real, jnp.int32), self._states)
+                device_index(i), jnp.asarray(ids),
+                device_index(n_real), self._states)
             seq.fed += n_real
             seq.steps += 1
             self._m_prefill_tokens.inc(n_real)
             self._m_prefill_chunk.record(n_real)
             if seq.sampling:  # final chunk: its output is the first token
-                self._consume(i, seq, np.asarray(probs))
+                self._consume(i, seq, host_read(probs))
             self._prefill_next = (i + 1) % self.n_slots
             return i
         return None
+
+    def _step_once(self) -> bool:
+        """One scheduler iteration (admission + at most one prefill chunk
+        + the all-slots decode step). Returns False when it idled.
+
+        Host<->device discipline: the ONLY blocking device reads are the
+        two `host_read` calls (next-token distributions — the sampled
+        token must reach the host to be fed back); everything else ships
+        to device explicitly (`jnp.asarray` of ndarrays, `device_index`).
+        Metric counters are flushed once per iteration, not per token."""
+        self._evict_cancelled()
+        self._admit()
+        # single-writer: _slots is mutated only by this scheduler thread
+        # once start() returns (submit() touches only _queue, under
+        # _cond); stop() joins the thread before its own sweep
+        active = [(i, s) for i, s in enumerate(self._slots)  # graftlint: disable=CC004
+                  if s is not None]
+        if not active:
+            return False
+        t0 = time.monotonic()
+        self._emitted_this_iter = 0
+        chunked = self._run_prefill_chunk()
+        # decode step: every decode-ready slot, plus token-by-token
+        # prefill for slots chunked prefill cannot serve (disabled, or
+        # no bucket fits the remaining cache headroom)
+        fed: List[Tuple[int, _ActiveSeq]] = []
+        for i, seq in active:
+            if self._slots[i] is not seq or i == chunked:
+                continue  # evicted above / consumed its iteration
+            if not seq.sampling and self.prefill_buckets \
+                    and self._pick_chunk(seq)[1]:
+                continue  # mid-prefill: waits for its chunk turn
+            fed.append((i, seq))
+        if fed:
+            ids = np.zeros((self.n_slots,), np.int32)
+            live = np.zeros((self.n_slots,), bool)
+            for i, seq in fed:
+                ids[i] = seq.next_input()
+                live[i] = True
+            probs, new_states = self._jstep(
+                self.net.params, self.net.variables, jnp.asarray(ids),
+                jnp.asarray(live), self._states)
+            self._states = new_states
+            probs = host_read(probs)
+            for i, seq in fed:
+                seq.steps += 1
+                was_sampling = seq.sampling
+                if seq.fed < len(seq.prompt):
+                    seq.fed += 1
+                if not was_sampling and not seq.sampling:
+                    continue  # still prefilling; output not sampled yet
+                self._consume(i, seq, probs[i])
+        if self._emitted_this_iter:
+            self._m_tokens.inc(self._emitted_this_iter)
+        self._m_occupancy.record(len(active))
+        self._m_step_time.record(time.monotonic() - t0)
+        return True
 
     def _loop(self) -> None:
         while True:
             with self._cond:
                 if not self._running:
                     return  # stop() fails any still-active handles
-            self._evict_cancelled()
-            self._admit()
-            active = [(i, s) for i, s in enumerate(self._slots)
-                      if s is not None]
-            if not active:
+            guard = (jax.transfer_guard(self._transfer_guard)
+                     if self._transfer_guard else contextlib.nullcontext())
+            with guard:
+                stepped = self._step_once()
+            if not stepped:
                 with self._cond:
                     if not self._running:
                         return
                     if not self._queue:
                         self._cond.wait(timeout=0.1)
-                continue
-            t0 = time.monotonic()
-            chunked = self._run_prefill_chunk()
-            # decode step: every decode-ready slot, plus token-by-token
-            # prefill for slots chunked prefill cannot serve (disabled, or
-            # no bucket fits the remaining cache headroom)
-            fed: List[Tuple[int, _ActiveSeq]] = []
-            for i, seq in active:
-                if self._slots[i] is not seq or i == chunked:
-                    continue  # evicted above / consumed its iteration
-                if not seq.sampling and self.prefill_buckets \
-                        and self._pick_chunk(seq)[1]:
-                    continue  # mid-prefill: waits for its chunk turn
-                fed.append((i, seq))
-            if fed:
-                ids = np.zeros((self.n_slots,), np.int32)
-                live = np.zeros((self.n_slots,), bool)
-                for i, seq in fed:
-                    ids[i] = seq.next_input()
-                    live[i] = True
-                probs, new_states = self._jstep(
-                    self.net.params, self.net.variables, jnp.asarray(ids),
-                    jnp.asarray(live), self._states)
-                self._states = new_states
-                probs = np.asarray(probs)
-                for i, seq in fed:
-                    seq.steps += 1
-                    was_sampling = seq.sampling
-                    if seq.fed < len(seq.prompt):
-                        seq.fed += 1
-                    if not was_sampling and not seq.sampling:
-                        continue  # still prefilling; output not sampled yet
-                    self._consume(i, seq, probs[i])
-            self._m_occupancy.record(len(active))
-            self._m_step_time.record(time.monotonic() - t0)

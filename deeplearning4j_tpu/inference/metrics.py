@@ -118,17 +118,29 @@ class Histogram:
 
     @property
     def mean(self) -> float:
-        return self._sum / self._count if self._count else 0.0
+        # derived from one locked copy: a lock-free (_sum, _count) pair
+        # read racing record() could pair a new sum with an old count
+        _, count, total, _, _ = self._state()
+        return total / count if count else 0.0
 
-    def percentile(self, q: float) -> float:
-        """Estimated q-quantile (q in [0, 1]): walk to the owning bucket,
-        interpolate linearly inside it, clamp to the observed min/max."""
+    def _state(self) -> tuple:
+        """ONE consistent copy of the mutable state, under ONE lock
+        acquisition. Every read path (percentile, snapshot) derives from
+        a single copy — graftlint CC004 caught the original version
+        reading `_min`/`_max` lock-free and re-locking per percentile, so
+        a `/metrics` scrape racing `record()` could report a (count, sum)
+        pair from one instant and quantiles/extremes from another (e.g.
+        a count-1 histogram whose p99 was not its only sample)."""
         with self._lock:
-            total = self._count
-            if not total:
-                return 0.0
-            counts = list(self._counts)
-            vmin, vmax = self._min, self._max
+            return (list(self._counts), self._count, self._sum,
+                    self._min, self._max)
+
+    def _estimate(self, counts: List[int], total: int, vmin: float,
+                  vmax: float, q: float) -> float:
+        """Quantile over a consistent state copy: walk to the owning
+        bucket, interpolate linearly inside it, clamp to min/max."""
+        if not total:
+            return 0.0
         target = q * total
         seen = 0
         for i, c in enumerate(counts):
@@ -141,20 +153,24 @@ class Histogram:
             seen += c
         return vmax
 
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1])."""
+        counts, count, _, vmin, vmax = self._state()
+        return self._estimate(counts, count, vmin, vmax, q)
+
     def snapshot(self) -> dict:
-        with self._lock:
-            count, total = self._count, self._sum
+        counts, count, total, vmin, vmax = self._state()
         if not count:
             return {"count": 0}
         return {
             "count": count,
             "sum": round(total, 6),
             "mean": round(total / count, 6),
-            "min": round(self._min, 6),
-            "max": round(self._max, 6),
-            "p50": round(self.percentile(0.50), 6),
-            "p95": round(self.percentile(0.95), 6),
-            "p99": round(self.percentile(0.99), 6),
+            "min": round(vmin, 6),
+            "max": round(vmax, 6),
+            "p50": round(self._estimate(counts, count, vmin, vmax, 0.50), 6),
+            "p95": round(self._estimate(counts, count, vmin, vmax, 0.95), 6),
+            "p99": round(self._estimate(counts, count, vmin, vmax, 0.99), 6),
         }
 
 

@@ -20,6 +20,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..analysis.runtime import host_read
 from ..datasets.dataset import DataSet
 from ..datasets.iterators import DataSetIterator
 
@@ -203,7 +204,9 @@ class ServeRoute:
                             break
                         batch.append(nxt)
                     ds = self.converter.convert(batch)
-                    out = np.asarray(self.net.output(ds.features))
+                    # declared device->host boundary: predictions must
+                    # reach numpy before on_prediction ships them out
+                    out = host_read(self.net.output(ds.features))
                     self.on_prediction(out)
             except BaseException as e:
                 self.error = e

@@ -18,6 +18,7 @@ import urllib.request
 import numpy as np
 import pytest
 
+from deeplearning4j_tpu.analysis import CompileCounter, device_residency
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import (AsyncDataSetIterator,
                                                    DataSetIterator)
@@ -57,8 +58,12 @@ def test_chunked_prefill_matches_token_by_token_and_solo_greedy():
     solo = [generate_transformer(net, p, n, V, use_cache=True)
             for p, n in zip(prompts, n_new)]
 
+    # transfer_guard="disallow": prefill-equivalence runs under the
+    # device-residency audit — implicit host<->device transfers in the
+    # hot loop fail the test, host_read is the allow-listed readback
     eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
-                      metrics=MetricsRegistry()).start()
+                          metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
     try:
         handles = [eng.submit(p, n) for p, n in zip(prompts, n_new)]
         chunked = [h.result(120) for h in handles]
@@ -98,7 +103,8 @@ def test_chunked_prefill_seeded_sampling_matches_solo():
                                  top_p=0.9, seed=42 + i, use_cache=True)
             for i, p in enumerate(prompts)]
     eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=8,
-                          metrics=MetricsRegistry()).start()
+                          metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
     try:
         got = [h.result(120) for h in
                [eng.submit(p, 7, temperature=0.8, top_k=5, top_p=0.9,
@@ -118,7 +124,8 @@ def test_chunked_prefill_lstm_facade():
     prompts = [list(rng.integers(0, V, 23)), [3], list(rng.integers(0, V, 16))]
     solo = [generate_rnn(rnn, p, 5, V) for p in prompts]
     eng = DecodeScheduler(rnn, V, n_slots=2, prefill_chunk=16,
-                          metrics=MetricsRegistry()).start()
+                          metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
     try:
         handles = [eng.submit(p, 5) for p in prompts]
         got = [h.result(120) for h in handles]
@@ -171,12 +178,16 @@ def test_tail_without_bucket_headroom_falls_back_token_by_token():
 def test_recompile_guard_one_decode_program_bounded_prefill_programs():
     """A mixed workload of prompt lengths must compile exactly 1 decode
     program and at most one prefill program per pow2 chunk bucket — the
-    compile-once-per-bucket discipline future changes must not break."""
+    compile-once-per-bucket discipline future changes must not break.
+    Enforced through the analysis.CompileCounter harness (the
+    generalization of the original ad-hoc _cache_size asserts): budgets
+    are decode=1, prefill<=#buckets, slot-reset=1."""
     V = 13
     net = _lm(V, cache=200)
     rng = np.random.default_rng(4)
     eng = DecodeScheduler(net, V, n_slots=3, prefill_chunk=64,
                           metrics=MetricsRegistry()).start()
+    audit = CompileCounter.for_scheduler(eng)
     try:
         lengths = [1, 3, 7, 15, 16, 17, 30, 33, 64, 65, 100, 130]
         handles = [eng.submit(list(rng.integers(0, V, n)), 3)
@@ -185,9 +196,61 @@ def test_recompile_guard_one_decode_program_bounded_prefill_programs():
             h.result(120)
     finally:
         eng.stop()
-    assert eng._jstep._cache_size() == 1
-    assert 1 <= eng._jprefill._cache_size() <= len(eng.prefill_buckets)
+    audit.assert_within_budget()
+    counts = audit.counts()
+    assert counts["decode"] == 1
+    assert 1 <= counts["prefill"] <= len(eng.prefill_buckets)
+    assert counts["admit_reset"] == 1
     assert eng.prefill_buckets == [16, 32, 64]
+
+
+def test_compile_counter_catches_a_recompile_storm():
+    """The harness itself must fail loudly when a jit function's program
+    family grows past budget (the invariant the decode scheduler relies
+    on)."""
+    import jax
+    import jax.numpy as jnp
+    f = jax.jit(lambda x: x * 2)
+    audit = CompileCounter().track("f", f, budget=1)
+    f(jnp.ones((2,)))
+    assert audit.check() == [] and audit.count("f") == 1
+    f(jnp.ones((3,)))  # second shape -> second program
+    problems = audit.check()
+    assert problems and "budget" in problems[0]
+    with pytest.raises(AssertionError, match="recompile"):
+        audit.assert_within_budget()
+
+
+def test_decode_hot_loop_device_residency_process_wide():
+    """With the PROCESS-wide transfer guard at "disallow" (covering the
+    scheduler thread, unlike the thread-local context form), a warmed
+    engine still serves requests token-identically: the hot loop's only
+    host<->device crossings are the declared explicit boundaries. A
+    deliberate implicit transfer under the fixture must raise."""
+    import jax
+    import jax.numpy as jnp
+    V = 13
+    net = _lm(V)
+    rng = np.random.default_rng(9)
+    warm_p = list(rng.integers(0, V, 37))  # compiles decode + bucket-16
+    prompts = [list(rng.integers(0, V, 21)), [5],
+               list(rng.integers(0, V, 33))]
+    solo = [generate_transformer(net, p, 4, V, use_cache=True)
+            for p in prompts]
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          metrics=MetricsRegistry()).start()
+    try:
+        eng.generate(warm_p, 2, timeout=120)  # warm every program family
+        with device_residency("disallow"):
+            got = [h.result(120) for h in
+                   [eng.submit(p, 4) for p in prompts]]
+            # the fixture really is armed: an implicit scalar transfer
+            # (the exact class of bug it guards against) raises
+            with pytest.raises(Exception, match="[Tt]ransfer"):
+                jnp.ones((2,)) + 1.0
+    finally:
+        eng.stop()
+    assert got == solo
 
 
 # ------------------------------------------------------------ cancel leak --

@@ -1,0 +1,676 @@
+"""graftlint rule pack tests (ISSUE 3 satellite).
+
+Every rule gets fixture snippets exercising a true positive, a true
+negative, and (for the per-line machinery) suppression comments; the
+baseline ledger round-trips; the CLI emits JSON and meaningful exit
+codes; the runtime lock audit records real acquisition orders and the
+cross-check rejects an order inversion against the static graph.
+"""
+import json
+import textwrap
+import threading
+
+import pytest
+
+from deeplearning4j_tpu.analysis import (Baseline, Linter, lock_audit,
+                                         crosscheck_lock_order)
+from deeplearning4j_tpu.analysis.concurrency_rules import (
+    BlockingCallUnderLock, ConditionWaitNoLoop, LockOrderCycle,
+    TornLockGuardedRead, build_lock_graph, find_cycle)
+from deeplearning4j_tpu.analysis.core import load_modules
+from deeplearning4j_tpu.analysis.jax_rules import (HostSyncInJit,
+                                                   ImpureInJit,
+                                                   JitMissingStatics,
+                                                   JitMutableGlobal,
+                                                   HostSyncInHotLoop,
+                                                   TracerBranch)
+from deeplearning4j_tpu.analysis.lint import main as lint_main
+
+
+def _lint(tmp_path, src, rules, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    findings, errors = Linter(rules).run([p])
+    assert not errors, errors
+    return findings
+
+
+# ------------------------------------------------------------- JAX rules --
+def test_jg001_host_sync_in_jit(tmp_path):
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def bad_float(x):
+        return float(x) * 2.0
+
+    @jax.jit
+    def bad_asarray(x):
+        y = x + 1
+        return np.asarray(y)
+
+    @jax.jit
+    def bad_item(x):
+        return x.sum().item()
+
+    @jax.jit
+    def ok_constant(x):
+        n = float("1.5")
+        return x * n
+
+    def ok_untraced(x):
+        return float(x)
+    """
+    found = _lint(tmp_path, src, [HostSyncInJit()])
+    assert sorted(f.symbol for f in found) == \
+        ["bad_asarray", "bad_float", "bad_item"]
+    assert all(f.rule == "JG001" for f in found)
+
+
+def test_jg001_control_flow_bodies_are_traced_at_their_arg_position(tmp_path):
+    """lax.cond/while_loop/fori_loop take their functions at positions
+    1-2 / 0-1 / 2 — the bodies must be traced (and the scalar bounds /
+    predicate args must NOT falsely trace same-named functions)."""
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def cond_true(x):
+        return float(x)
+
+    def loop_body(i, x):
+        return x + int(x)
+
+    def lo(x):
+        return float(x)
+
+    def run(pred, x):
+        a = jax.lax.cond(pred, cond_true, lambda v: v, x)
+        b = jax.lax.fori_loop(0, 3, loop_body, x)
+        return a + b
+    """
+    found = _lint(tmp_path, src, [HostSyncInJit()])
+    # cond_true (pos 1) and loop_body (pos 2) are traced and flagged;
+    # `lo` shares no seeding path (fori_loop's 0 is not a function)
+    assert sorted(f.symbol for f in found) == ["cond_true", "loop_body"]
+
+
+def test_jg001_traced_via_jit_callsite_and_transitive_helper(tmp_path):
+    src = """
+    import jax
+
+    class Engine:
+        def __init__(self):
+            self._jstep = jax.jit(self._step)
+
+        def _step(self, x):
+            return self._helper(x)
+
+        def _helper(self, x):
+            return int(x)
+    """
+    found = _lint(tmp_path, src, [HostSyncInJit()])
+    assert [f.symbol for f in found] == ["Engine._helper"]
+
+
+def test_jg002_tracer_branch(tmp_path):
+    src = """
+    import jax
+
+    @jax.jit
+    def bad(x):
+        if x > 0:
+            return x
+        return -x
+
+    @jax.jit
+    def ok_metadata(x, y):
+        if x.ndim == 2:
+            x = x[None]
+        if y is None:
+            return x
+        return x + y
+
+    @jax.jit
+    def ok_structure(tree):
+        out = {}
+        for k, v in tree.items():
+            if "pos" in v:
+                out[k] = v
+        return out
+    """
+    found = _lint(tmp_path, src, [TracerBranch()])
+    assert [f.symbol for f in found] == ["bad"]
+    assert found[0].rule == "JG002"
+
+
+def test_jg002_mode_flag_of_transitive_helper_is_not_a_tracer(tmp_path):
+    """Inter-procedural taint: a helper reached from traced code with
+    train=False (a Python constant) may branch on `train` freely — only
+    params FED tainted values taint."""
+    src = """
+    import jax
+
+    class Net:
+        def __init__(self):
+            self._fwd = jax.jit(self._forward)
+
+        def _forward(self, params, x):
+            return self._impl(params, x, train=False)
+
+        def _impl(self, params, x, train):
+            if train:
+                x = x * 2
+            if (x > 0).any():
+                return x
+            return params[0] + x
+    """
+    found = _lint(tmp_path, src, [TracerBranch()])
+    # the branch on `train` is clean; the branch on `(x > 0).any()` fires
+    assert len(found) == 1 and found[0].symbol == "Net._impl"
+    assert "if (x > 0).any():" in found[0].snippet
+
+
+def test_jg003_mutable_global(tmp_path):
+    src = """
+    import jax
+
+    SCALE = [2.0]
+    LIMIT = 4
+
+    @jax.jit
+    def bad(x):
+        return x * SCALE[0]
+
+    @jax.jit
+    def ok(x):
+        return x * LIMIT
+    """
+    found = _lint(tmp_path, src, [JitMutableGlobal()])
+    assert len(found) == 1 and found[0].symbol == "bad"
+    assert "SCALE" in found[0].message
+
+
+def test_jg004_missing_statics(tmp_path):
+    src = """
+    from functools import partial
+    import jax
+
+    class Sched:
+        def __init__(self):
+            self._j = jax.jit(self._fn)
+            self._k = jax.jit(self._plain)
+
+        def _fn(self, x, n_real):
+            return x[:n_real]
+
+        def _plain(self, x, y):
+            return x + y
+
+    @jax.jit
+    def pad(x, size):
+        return x
+
+    @partial(jax.jit, static_argnames=("size",))
+    def pad_ok(x, size):
+        return x
+    """
+    found = _lint(tmp_path, src, [JitMissingStatics()])
+    msgs = {f.symbol: f.message for f in found}
+    assert len(found) == 2
+    assert "n_real" in msgs["Sched.__init__"]
+    assert "size" in msgs["pad"]
+
+
+def test_jg005_impure_in_jit(tmp_path):
+    src = """
+    import time
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def bad_time(x):
+        return x + time.time()
+
+    @jax.jit
+    def bad_rng(x):
+        r = np.random.default_rng(0)
+        return x
+
+    def ok_host():
+        return time.time()
+    """
+    found = _lint(tmp_path, src, [ImpureInJit()])
+    assert sorted(f.symbol for f in found) == ["bad_rng", "bad_time"]
+
+
+def test_jg006_host_sync_in_hot_loop(tmp_path):
+    src = """
+    import threading
+    import numpy as np
+    from deeplearning4j_tpu.analysis.runtime import host_read
+
+    class Sched:
+        def start(self):
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                out = self._step()
+                arr = np.asarray(out)
+                lens = np.array([1, 2, 3])
+                ok = host_read(out)
+                val = float(out.max())
+                n_done = int(lens[0] + 1)
+                t = float(self._t0)
+                self._dispatch(out)
+
+        def _dispatch(self, out):
+            return np.asarray(self._mangle(out))
+
+        def _step(self):
+            return [1.0]
+
+        def _mangle(self, x):
+            return x
+
+    def cold_path(x):
+        return np.asarray(x)
+    """
+    found = _lint(tmp_path, src, [HostSyncInHotLoop()])
+    # np.asarray and float(<call result>) in the loop, plus np.asarray in
+    # the loop-called helper, fire; the literal np.array, host_read,
+    # int(<arithmetic>), and float(<plain attr>) (host-side times/
+    # counters) do not, and neither does the cold path
+    assert sorted(f.symbol for f in found) == \
+        ["Sched._dispatch", "Sched._loop", "Sched._loop"]
+    assert all(f.rule == "JG006" for f in found)
+    assert any("float()" in f.message for f in found)
+
+
+# ----------------------------------------------------- concurrency rules --
+def test_cc001_lock_order_cycle(tmp_path):
+    src = """
+    import threading
+
+    class AB:
+        def __init__(self):
+            self.l1 = threading.Lock()
+            self.l2 = threading.Lock()
+
+        def fwd(self):
+            with self.l1:
+                with self.l2:
+                    pass
+
+        def back(self):
+            with self.l2:
+                with self.l1:
+                    pass
+    """
+    found = _lint(tmp_path, src, [LockOrderCycle()])
+    assert len(found) == 1 and found[0].rule == "CC001"
+    assert "cycle" in found[0].message
+
+    ok = """
+    import threading
+
+    class AB:
+        def __init__(self):
+            self.l1 = threading.Lock()
+            self.l2 = threading.Lock()
+
+        def fwd(self):
+            with self.l1:
+                with self.l2:
+                    pass
+
+        def also_fwd(self):
+            with self.l1:
+                with self.l2:
+                    pass
+    """
+    assert _lint(tmp_path, ok, [LockOrderCycle()], name="ok.py") == []
+
+
+def test_cc001_cycle_through_interprocedural_edge(tmp_path):
+    """One level of call propagation: holding A while calling a method
+    that takes B, while another path holds B and calls a method taking A
+    — the cycle spans two classes and closes through calls."""
+    src = """
+    import threading
+
+    class Metrics:
+        def __init__(self):
+            self._mlock = threading.Lock()
+
+        def observe(self, engine):
+            with self._mlock:
+                engine.poke()
+
+    class Engine:
+        def __init__(self):
+            self._elock = threading.Lock()
+
+        def poke(self):
+            with self._elock:
+                pass
+
+        def step(self, metrics):
+            with self._elock:
+                metrics.observe(self)
+    """
+    found = _lint(tmp_path, src, [LockOrderCycle()])
+    assert len(found) == 1
+    assert "_mlock" in found[0].message and "_elock" in found[0].message
+
+
+def test_cc002_blocking_call_under_lock(tmp_path):
+    src = """
+    import queue
+    import threading
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition()
+            self._q = queue.Queue()
+            self._d = {}
+
+        def bad_get(self):
+            with self._lock:
+                return self._q.get()
+
+        def bad_join(self, t):
+            with self._lock:
+                t.join()
+
+        def ok_timeout(self):
+            with self._lock:
+                return self._q.get(timeout=1.0)
+
+        def ok_dict(self):
+            with self._lock:
+                return self._d.get("key")
+
+        def ok_own_cond_wait(self):
+            with self._cond:
+                while not self._d:
+                    self._cond.wait(timeout=0.1)
+
+        def ok_unlocked(self):
+            return self._q.get()
+
+        def ok_nonblocking_put(self, item):
+            with self._lock:
+                self._q.put(item, block=False)
+
+        def bad_blocking_put(self, item):
+            with self._lock:
+                self._q.put(item, block=True)
+    """
+    found = _lint(tmp_path, src, [BlockingCallUnderLock()])
+    assert sorted(f.symbol for f in found) == \
+        ["W.bad_blocking_put", "W.bad_get", "W.bad_join"]
+    assert all(f.rule == "CC002" for f in found)
+
+
+def test_cc003_condition_wait_needs_predicate_loop(tmp_path):
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._items = []
+
+        def bad(self):
+            with self._cond:
+                if not self._items:
+                    self._cond.wait()
+                return self._items.pop()
+
+        def good(self):
+            with self._cond:
+                while not self._items:
+                    self._cond.wait()
+                return self._items.pop()
+    """
+    found = _lint(tmp_path, src, [ConditionWaitNoLoop()])
+    assert len(found) == 1 and found[0].symbol == "C.bad"
+    assert found[0].rule == "CC003"
+
+
+def test_cc004_torn_lock_guarded_read(tmp_path):
+    src = """
+    import threading
+
+    class Hist:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._vmin = 0.0
+
+        def record(self, v):
+            with self._lock:
+                self._count += 1
+                if v < self._vmin:
+                    self._vmin = v
+
+        def snapshot(self):
+            with self._lock:
+                c = self._count
+            return c, self._vmin
+
+    class FixedHist:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._vmin = 0.0
+
+        def record(self, v):
+            with self._lock:
+                self._count += 1
+                if v < self._vmin:
+                    self._vmin = v
+
+        def snapshot(self):
+            with self._lock:
+                return self._count, self._vmin
+
+    class SingleWriter:
+        def __init__(self):
+            self._slots = [None]
+
+        def touch(self):
+            self._slots[0] = 1
+    """
+    found = _lint(tmp_path, src, [TornLockGuardedRead()])
+    assert len(found) == 1
+    assert found[0].symbol == "Hist.snapshot" and "_vmin" in found[0].message
+
+
+# ------------------------------------------- suppressions and baselining --
+def test_inline_suppression_by_rule_and_blanket(tmp_path):
+    src = """
+    import jax
+
+    @jax.jit
+    def a(x):
+        return float(x)  # graftlint: disable=JG001
+
+    @jax.jit
+    def b(x):
+        return float(x)  # graftlint: disable
+
+    @jax.jit
+    def c(x):
+        return float(x)  # graftlint: disable=JG999
+
+    @jax.jit
+    def d(x):
+        return float(x)
+    """
+    found = _lint(tmp_path, src, [HostSyncInJit()])
+    # a (rule-scoped) and b (blanket) are silenced; c's suppression names
+    # a different rule so the finding stands; d is plain
+    assert sorted(f.symbol for f in found) == ["c", "d"]
+
+
+def test_baseline_round_trip_and_diff(tmp_path):
+    src = """
+    import jax
+
+    @jax.jit
+    def one(x):
+        return float(x)
+
+    @jax.jit
+    def two(x):
+        return int(x)
+    """
+    found = _lint(tmp_path, src, [HostSyncInJit()])
+    assert len(found) == 2
+    bl_path = tmp_path / "baseline.json"
+    Baseline.from_findings(found).save(bl_path)
+    loaded = Baseline.load(bl_path)
+    new, fixed = loaded.diff(found)
+    assert new == [] and fixed == []
+
+    # a NEW violation (different function) is caught even though two old
+    # ones are baselined; fingerprints survive line shifts (the header
+    # comment moves everything down)
+    src2 = "# a new header comment\n" + textwrap.dedent(src) + \
+        "\n@jax.jit\ndef three(x):\n    return float(x)\n"
+    (tmp_path / "snippet.py").write_text(src2)
+    found2, _ = Linter([HostSyncInJit()]).run([tmp_path / "snippet.py"])
+    new2, fixed2 = loaded.diff(found2)
+    assert len(found2) == 3 and len(new2) == 1
+    assert new2[0].symbol == "three" and fixed2 == []
+
+    # a fixed finding shows up as retirable
+    (tmp_path / "snippet.py").write_text(textwrap.dedent("""
+    import jax
+
+    @jax.jit
+    def one(x):
+        return float(x)
+    """))
+    found3, _ = Linter([HostSyncInJit()]).run([tmp_path / "snippet.py"])
+    new3, fixed3 = loaded.diff(found3)
+    assert new3 == [] and len(fixed3) == 1
+
+
+def test_cli_json_exit_codes_and_update_baseline(tmp_path, capsys):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""
+    import jax
+
+    @jax.jit
+    def f(x):
+        return float(x)
+    """))
+    bl = tmp_path / "bl.json"
+    rc = lint_main([str(p), "--format", "json", "--baseline", str(bl)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["summary"]["new"] == 1 and out["summary"]["total"] == 1
+    assert out["findings"][0]["rule"] == "JG001"
+
+    rc = lint_main([str(p), "--update-baseline", "--baseline", str(bl)])
+    capsys.readouterr()
+    assert rc == 0 and bl.exists()
+    rc = lint_main([str(p), "--baseline", str(bl)])
+    txt = capsys.readouterr().out
+    assert rc == 0 and "0 new" in txt
+
+    # partial runs must not rewrite the ledger: a rules subset, or a
+    # path subset aimed at the default package ledger, are usage errors
+    assert lint_main([str(p), "--update-baseline", "--baseline", str(bl),
+                      "--rules", "JG001"]) == 2
+    assert lint_main([str(p), "--update-baseline"]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------- runtime lock audit --
+def test_lock_audit_records_real_acquisition_order():
+    with lock_audit() as auditor:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with a:
+            with b:
+                pass
+    edges = auditor.observed_edges()
+    ours = {(x, y) for x, y in edges
+            if x[0].endswith("test_graftlint.py")
+            and y[0].endswith("test_graftlint.py")}
+    assert len(ours) == 1
+    (site_a, site_b), = ours
+    assert site_a[1] < site_b[1]  # a allocated before b
+
+
+def test_lock_audit_reentrant_rlock_records_no_inverted_edge():
+    """Legal RLock re-entry below another held lock must not record the
+    inverted (other -> rlock) edge — that would fabricate a deadlock
+    cycle out of correct reentrant code."""
+    with lock_audit() as auditor:
+        r = threading.RLock()
+        b = threading.Lock()
+        with r:
+            with b:
+                with r:  # re-entry while b sits above r on the stack
+                    pass
+    ours = {(x, y) for x, y in auditor.observed_edges()
+            if x[0].endswith("test_graftlint.py")
+            and y[0].endswith("test_graftlint.py")}
+    assert len(ours) == 1  # just r -> b
+    (site_r, site_b), = ours
+    assert site_r[1] < site_b[1]
+
+
+def test_crosscheck_rejects_order_inversion(tmp_path):
+    p = tmp_path / "locks.py"
+    p.write_text(textwrap.dedent("""
+    import threading
+
+    class S:
+        def __init__(self):
+            self.first = threading.Lock()
+            self.second = threading.Lock()
+
+        def step(self):
+            with self.first:
+                with self.second:
+                    pass
+    """))
+    mods, errors = load_modules([p])
+    assert not errors
+    graph = build_lock_graph(mods)
+    assert len(graph.locks) == 2 and len(graph.edges) == 1
+    sites = {lid.split(":")[-1]: (d.path, d.line)
+             for lid, d in graph.locks.items()}
+    first = sites["S.first"]
+    second = sites["S.second"]
+
+    # consistent runtime order: clean
+    violations, unmodeled = crosscheck_lock_order({(first, second)}, graph)
+    assert violations == [] and unmodeled == []
+    # inverted runtime order closes a cycle against the static edge
+    violations, _ = crosscheck_lock_order({(second, first)}, graph)
+    assert len(violations) == 1 and "cycle" in violations[0]
+    # edges involving unknown sites are ignored, not crashes
+    violations, unmodeled = crosscheck_lock_order(
+        {(("elsewhere.py", 1), first)}, graph)
+    assert violations == [] and unmodeled == []
+
+
+def test_find_cycle_helper():
+    assert find_cycle({("a", "b"), ("b", "c")}) is None
+    cyc = find_cycle({("a", "b"), ("b", "c"), ("c", "a")})
+    assert cyc is not None and cyc[0] == cyc[-1]
+    assert find_cycle({("a", "a")}) is None  # RLock re-entry is legal

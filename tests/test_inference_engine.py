@@ -57,6 +57,85 @@ def test_histogram_percentiles():
     assert snap["max"] == pytest.approx(0.1)
 
 
+class _CountingLock:
+    """Lock proxy counting acquisitions (context-manager uses only)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.acquisitions = 0
+
+    def __enter__(self):
+        self._lock.acquire()
+        self.acquisitions += 1
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        return False
+
+
+def test_histogram_snapshot_is_one_atomic_lock_acquisition():
+    """Regression for the graftlint CC004 finding: snapshot() used to
+    read count/sum under the lock but min/max lock-free and re-acquire
+    per percentile — a scrape racing record() could pair a count from one
+    instant with quantiles from another (e.g. a count-1 snapshot whose
+    p99 was not its only sample). The whole snapshot (and each
+    percentile) must derive from ONE locked copy of the state."""
+    m = MetricsRegistry()
+    h = m.histogram("atomic")
+    for v in (0.002, 0.02, 0.2):
+        h.record(v)
+    counter = _CountingLock()
+    h._lock = counter
+    snap = h.snapshot()
+    assert counter.acquisitions == 1, \
+        "snapshot must take the instrument lock exactly once"
+    assert snap["count"] == 3
+    assert snap["min"] <= snap["p50"] <= snap["p95"] <= snap["p99"] \
+        <= snap["max"]
+    counter.acquisitions = 0
+    h.percentile(0.5)
+    assert counter.acquisitions == 1
+
+
+def test_histogram_snapshot_consistent_under_concurrent_records():
+    """Hammer: a writer records values from a fixed set while snapshots
+    stream; every snapshot must be internally consistent (ordered
+    quantiles inside [min, max], mean inside [min, max], sum/mean/count
+    agreeing) — torn multi-lock snapshots break these invariants."""
+    m = MetricsRegistry()
+    h = m.histogram("hammer")
+    stop = threading.Event()
+
+    def writer():
+        vals = (0.001, 0.005, 0.05, 0.5)
+        i = 0
+        while not stop.is_set():
+            h.record(vals[i % 4])
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 2.0
+        checked = 0
+        while time.monotonic() < deadline:
+            snap = h.snapshot()
+            if not snap.get("count"):
+                continue
+            checked += 1
+            assert snap["min"] <= snap["p50"] <= snap["p95"] \
+                <= snap["p99"] <= snap["max"]
+            assert snap["min"] <= snap["mean"] <= snap["max"]
+            # snapshot rounds to 6 decimals; compare within that grain
+            assert snap["mean"] == pytest.approx(
+                snap["sum"] / snap["count"], abs=2e-6)
+        assert checked > 50
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
 def test_registry_snapshot_and_text():
     m = MetricsRegistry()
     m.counter("reqs").inc(3)
@@ -337,7 +416,12 @@ def test_decode_scheduler_matches_solo_greedy():
     n_new = [6, 4, 3, 7, 5]
     solo = [generate_transformer(net, p, n, V, use_cache=True)
             for p, n in zip(prompts, n_new)]
-    eng = DecodeScheduler(net, V, n_slots=2).start()
+    # transfer_guard="disallow" locks in device residency of the decode
+    # step: any implicit host<->device transfer in the hot loop raises
+    # (the sampled-token readback goes through the allow-listed
+    # analysis.runtime.host_read boundary)
+    eng = DecodeScheduler(net, V, n_slots=2,
+                          transfer_guard="disallow").start()
     try:
         handles = [eng.submit(p, n) for p, n in zip(prompts, n_new)]
         got = [h.result(120) for h in handles]
