@@ -357,6 +357,89 @@ def bench_decode_prefill(prompt_len=256, new_tokens=16, chunk=64,
     }
 
 
+def bench_prefix_reuse(prompt_len=256, new_tokens=16, chunk=64, vocab=64,
+                       kv_block=16, cache_mb=8.0) -> dict:
+    """Prefix-KV-reuse A/B on the decode scheduler (ISSUE 4 acceptance):
+    the SAME 256-token prompt served twice through a prefix-cached engine
+    (inference/kvpool.py) vs a cold engine. The first pass publishes the
+    prompt's K/V blocks into the pool; the repeat restores the cached
+    prefix in ONE block-gather program and only prefills the cold tail,
+    so TTFT-in-engine-steps must drop to <= 1/4 of the cold path while
+    greedy outputs stay token-identical to the no-pool engine and solo
+    decoding, and pool bytes stay under the configured budget.
+    Standalone-runnable:
+        python -c "import bench, json; print(json.dumps(bench.bench_prefix_reuse()))"
+    """
+    from deeplearning4j_tpu.inference import DecodeScheduler, MetricsRegistry
+    from deeplearning4j_tpu.models.sampling import generate_transformer
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = transformer_lm(vocab_size=vocab, d_model=64, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = prompt_len + new_tokens
+    net = ComputationGraph(conf).init()
+    prompt = list(np.random.default_rng(11).integers(0, vocab, prompt_len))
+    solo = generate_transformer(net, prompt, new_tokens, vocab,
+                                use_cache=True)
+
+    cold_eng = DecodeScheduler(net, vocab, n_slots=2,
+                               prefill_chunk=chunk,
+                               metrics=MetricsRegistry()).start()
+    try:
+        cold_eng.submit(prompt, new_tokens).result(600)  # warm (compiles)
+        h_cold = cold_eng.submit(prompt, new_tokens)
+        cold_tokens = h_cold.result(600)
+    finally:
+        cold_eng.stop()
+
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, vocab, n_slots=2, prefill_chunk=chunk,
+                          prefix_cache_mb=cache_mb, kv_block=kv_block,
+                          metrics=m).start()
+    try:
+        first = eng.submit(prompt, new_tokens)
+        first_tokens = first.result(600)  # cold pass: publishes blocks
+        eng.submit(prompt, new_tokens).result(600)  # compiles the restore
+        hit0 = m.counter("prefix_cache_hit_tokens_total").value
+        h_warm = eng.submit(prompt, new_tokens)
+        warm_tokens = h_warm.result(600)  # repeat: restores the prefix
+        pool = eng.pool
+        budget = int(cache_mb * (1 << 20))
+        pool_bytes = (pool.capacity_blocks + 1) * pool.bytes_per_block
+        within = pool_bytes <= budget and pool.used_bytes <= budget
+        hit_tokens = m.counter("prefix_cache_hit_tokens_total").value - hit0
+    finally:
+        eng.stop()
+    steps_cold = h_cold.steps_to_first_token
+    steps_warm = h_warm.steps_to_first_token
+    return {
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "prefill_chunk": chunk,
+        "kv_block": kv_block,
+        "prefix_cache_mb": cache_mb,
+        "ttft_steps_cold": steps_cold,
+        "ttft_steps_warm": steps_warm,
+        "ttft_steps_ratio": round(steps_warm / steps_cold, 4),
+        "ttft_ms_cold": round((h_cold.t_first_token - h_cold.t_submit)
+                              * 1e3, 2),
+        "ttft_ms_warm": round((h_warm.t_first_token - h_warm.t_submit)
+                              * 1e3, 2),
+        "hit_tokens": hit_tokens,
+        "pool_bytes_within_budget": within,
+        "outputs_identical": (cold_tokens == warm_tokens
+                              == first_tokens == solo),
+        "note": f"same {prompt_len}-token prompt twice, 2-block d64 "
+                "transformer LM (RoPE); warm = radix-trie prefix hit "
+                f"restored via one block-gather (block {kv_block}), cold "
+                "= full chunked prefill on a pool-less engine",
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -840,6 +923,12 @@ def main() -> None:
         WORKLOADS["decode_prefill"] = bench_decode_prefill()
     except Exception as e:
         WORKLOADS["decode_prefill"] = {"error": str(e)}
+
+    # ---- serving: prefix-KV-reuse repeat-prompt A/B (ISSUE 4) -----------
+    try:
+        WORKLOADS["prefix_reuse"] = bench_prefix_reuse()
+    except Exception as e:
+        WORKLOADS["prefix_reuse"] = {"error": str(e)}
 
     # ---- perf-regression gate vs committed floors (BENCH_FLOORS.json) ----
     regressions = check_floors(WORKLOADS)

@@ -134,7 +134,9 @@ class CompileCounter:
     def for_scheduler(cls, scheduler) -> "CompileCounter":
         """Budgets for a DecodeScheduler: 1 decode program, <=1 prefill
         program per pow2 chunk bucket (0 when chunking is off), 1
-        slot-reset program."""
+        slot-reset program, and — when the prefix KV pool is enabled —
+        <=1 restore and <=1 publish program per pow2 block-chain bucket
+        (kvpool.gather_blocks / scatter_blocks)."""
         c = cls()
         c.track("decode", scheduler._jstep, budget=1)
         c.track("prefill", scheduler._jprefill,
@@ -142,6 +144,14 @@ class CompileCounter:
         jzero = getattr(scheduler, "_jzero", None)
         if jzero is not None:
             c.track("admit_reset", jzero, budget=1)
+        jrestore = getattr(scheduler, "_jrestore", None)
+        if jrestore is not None:
+            c.track("prefix_restore", jrestore,
+                    budget=len(scheduler.restore_buckets))
+        jpublish = getattr(scheduler, "_jpublish", None)
+        if jpublish is not None:
+            c.track("prefix_publish", jpublish,
+                    budget=len(scheduler.restore_buckets))
         return c
 
 

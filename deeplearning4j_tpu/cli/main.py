@@ -13,7 +13,8 @@ Usage:
   dl4j-tpu serve   --model model.zip [--port P] [--int8] [--no-batching]
                    [--batch-window-ms MS] [--queue-size N] [--timeout-ms MS]
                    [--generate [--vocab-size V] [--decode-slots N]
-                    [--prefill-chunk C]]
+                    [--prefill-chunk C] [--prefix-cache-mb MB]
+                    [--kv-block B]]
 """
 from __future__ import annotations
 
@@ -103,7 +104,9 @@ def cmd_serve(args) -> int:
               max_queue=args.queue_size,
               default_timeout_ms=args.timeout_ms,
               decode_slots=args.decode_slots,
-              prefill_chunk=args.prefill_chunk)
+              prefill_chunk=args.prefill_chunk,
+              prefix_cache_mb=args.prefix_cache_mb,
+              kv_block=args.kv_block)
     if getattr(args, "int8", False):
         # artifact must carry calibration (nn/quantization.save_quantized);
         # weight quantization is rebuilt deterministically from the params
@@ -138,9 +141,17 @@ def cmd_serve(args) -> int:
     batch_mode = ("lock-serialized" if args.no_batching else
                   f"micro-batched, window {args.batch_window_ms}ms, "
                   f"queue {args.queue_size}")
+    # report the pool's ACTUAL state, not the flag: the scheduler
+    # disables it (with a RuntimeWarning) when the model has no KV cache
+    # or the budget cannot fit two blocks
+    pool_on = getattr(getattr(server, "_decoder", None), "pool",
+                      None) is not None
     gen_mode = (f"; /generate: {args.decode_slots} slots, "
-                f"prefill chunk {args.prefill_chunk}" if args.generate
-                else "")
+                f"prefill chunk {args.prefill_chunk}"
+                + (f", prefix cache {args.prefix_cache_mb}MB "
+                   f"(block {args.kv_block})" if pool_on
+                   else ", prefix cache OFF")
+                if args.generate else "")
     print(f"Serving {args.model} ({mode}, {batch_mode}{gen_mode}) on "
           f"http://127.0.0.1:{server.port} "
           "(POST /predict, /predict/csv"
@@ -226,6 +237,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max prompt tokens prefilled per engine step "
                         "(pow2 chunk buckets; TTFT/decode-latency knob; "
                         "<=1 = token-by-token prefill)")
+    s.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                   help="byte budget (MiB) for the prefix KV cache: "
+                        "completed prompts' K/V blocks are pooled and "
+                        "repeated prefixes restored instead of "
+                        "re-prefilled (0 = disabled)")
+    s.add_argument("--kv-block", type=int, default=16,
+                   help="positions per prefix-cache block (only full "
+                        "blocks of a prompt are shared)")
     s.add_argument("--once", action="store_true",
                    help="start and immediately stop (smoke test)")
     s.set_defaults(func=cmd_serve)

@@ -1,19 +1,22 @@
 """Production inference engine: continuous micro-batching, slot-based
-generative decode scheduling, and SLO metrics.
+generative decode scheduling, prefix KV reuse, and SLO metrics.
 
-The three pieces compose into the serving stack (`serving/server.py`):
+The pieces compose into the serving stack (`serving/server.py`):
 `MicroBatcher` aggregates concurrent `/predict` requests into bucketed
 padded batches; `DecodeScheduler` continuously batches generative decode
-over the attention KV cache; `MetricsRegistry` records queue depth, batch
-occupancy, and latency percentiles, exported at `GET /metrics`.
+over the attention KV cache, reusing cached prompt prefixes through the
+block-pooled `KVPool` prefix index; `MetricsRegistry` records queue
+depth, batch occupancy, hit rates, and latency percentiles, exported at
+`GET /metrics`.
 """
 from .batcher import (InferenceFuture, MicroBatcher, QueueFullError,
                       RequestTimeoutError, pow2_buckets)
-from .engine import DecodeHandle, DecodeScheduler
+from .engine import DecodeHandle, DecodeScheduler, PromptTooLongError
+from .kvpool import KVPool
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry)
 
 __all__ = ["Counter", "DecodeHandle", "DecodeScheduler", "Gauge",
-           "Histogram", "InferenceFuture", "MetricsRegistry", "MicroBatcher",
-           "QueueFullError", "RequestTimeoutError", "default_registry",
-           "pow2_buckets"]
+           "Histogram", "InferenceFuture", "KVPool", "MetricsRegistry",
+           "MicroBatcher", "PromptTooLongError", "QueueFullError",
+           "RequestTimeoutError", "default_registry", "pow2_buckets"]

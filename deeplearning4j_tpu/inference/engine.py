@@ -42,10 +42,25 @@ are masked out of the decode step *inside* the jitted program — their
 recurrent state and cache position are frozen by a `live` mask, so the
 shared-batch step cannot corrupt a half-prefilled slot.
 
+Prefix KV reuse (the ISSUE 4 tentpole, `inference/kvpool.py`): with
+``prefix_cache_mb > 0`` the engine keeps a block pool + radix-trie prefix
+index over completed prompts' prefill-written K/V. Admission walks the
+trie over the prompt's full ``kv_block``-sized blocks, restores the
+longest cached prefix into the slot's contiguous cache rows with ONE
+jitted block-gather program (bucketed by chain length, same pow2 compile
+discipline as prefill) and advances ``pos`` past the hit — chunked
+prefill then only runs the cold suffix, so a repeated prompt reaches its
+first token in ~1 engine step instead of O(prompt/C). When a sequence
+finishes, its prompt's full blocks are published back into the pool
+(copy out of the slot cache, functional scatter into pool storage) and
+indexed; cached keys are stored pre-rotated at absolute positions, so a
+pos-0-anchored prefix is bit-identical across requests.
+
 Token selection reuses `models/sampling.sample_logits`, so greedy engine
 output is token-identical to solo `generate_transformer(use_cache=True)`
-decoding (tested, chunked and token-by-token), and seeded sampled output
-matches too (same per-sequence RNG consumption order).
+decoding (tested, chunked and token-by-token, prefix-restored and cold),
+and seeded sampled output matches too (same per-sequence RNG consumption
+order).
 
 Works for both facades: transformer ComputationGraphs (KV-cache states)
 and recurrent MultiLayerNetworks (h/c states — admitting a sequence zeroes
@@ -54,8 +69,10 @@ its slot's rows).
 from __future__ import annotations
 
 import contextlib
+import functools
 import threading
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -68,12 +85,21 @@ from ..nn.layers.recurrent import (BaseRecurrentImpl,
                                    _materialize_rnn_states)
 from ..nn.multilayer import _compute_dtype_of
 from .batcher import QueueFullError, pow2_buckets
+from .kvpool import SCRATCH_BLOCK, KVPool, gather_blocks, scatter_blocks
 from .metrics import MetricsRegistry, default_registry
 
 # chunk buckets never go below this (a 3-token tail still pads to one
 # small program instead of compiling a 3-wide one-off); buckets smaller
 # than 16 only exist when prefill_chunk itself is smaller
 _MIN_CHUNK_BUCKET = 16
+
+
+class PromptTooLongError(ValueError):
+    """The request cannot fit the KV cache: ``len(prompt) +
+    max_new_tokens - 1 > max_cache_len``. Raised at submit time (never
+    admitted, never queued) so the serving layer can answer HTTP 413
+    instead of the sequence dying mid-decode on the attention layer's
+    cache-overflow guard."""
 
 
 class DecodeHandle:
@@ -127,7 +153,7 @@ class DecodeHandle:
 class _ActiveSeq:
     """Book-keeping for one slot-resident sequence."""
     __slots__ = ("handle", "prompt", "fed", "rng", "temperature", "top_k",
-                 "top_p", "eos_id", "steps")
+                 "top_p", "eos_id", "steps", "pool_node")
 
     def __init__(self, handle: DecodeHandle, prompt: Sequence[int],
                  temperature: float, top_k: Optional[int],
@@ -141,6 +167,7 @@ class _ActiveSeq:
         self.top_p = top_p
         self.eos_id = eos_id
         self.steps = 0  # engine iterations that advanced this sequence
+        self.pool_node = None  # locked trie node of the restored prefix
 
     def next_input(self) -> int:
         """Token to feed this step: the next prompt token while prefilling,
@@ -172,6 +199,13 @@ class DecodeScheduler:
     tail latency to resident decodes). <= 1 disables chunked prefill and
     restores token-by-token prompt feeding through the decode step.
 
+    ``prefix_cache_mb``: byte budget (MiB) for the prefix KV pool
+    (`inference/kvpool.py`); 0 disables prefix reuse. ``kv_block``:
+    positions per pool block — only full blocks of a prompt are shared,
+    so smaller blocks match more but cost more metadata. The pool only
+    engages for attention nets (pos-0-anchored KV prefixes; recurrent
+    h/c state has no position-addressed rows to share).
+
     ``transfer_guard``: device-residency audit mode. When set (e.g.
     "disallow"), every scheduler iteration runs under that thread-local
     ``jax.transfer_guard`` level: any *implicit* host<->device transfer in
@@ -183,6 +217,7 @@ class DecodeScheduler:
 
     def __init__(self, net, vocab_size: int, *, n_slots: int = 4,
                  max_queue: int = 64, prefill_chunk: int = 64,
+                 prefix_cache_mb: float = 0.0, kv_block: int = 16,
                  metrics: Optional[MetricsRegistry] = None,
                  transfer_guard: Optional[str] = None):
         if n_slots < 1:
@@ -232,6 +267,57 @@ class DecodeScheduler:
         self._chunk_dense = bool(stateful) and all(
             type(impl).__name__ == "SelfAttentionLayerImpl"
             for impl in stateful)
+        # prefix KV reuse (kvpool.py): attention nets only — cached
+        # prefixes are position-addressed K/V rows anchored at pos 0,
+        # which recurrent h/c state does not have
+        self.kv_block = int(kv_block)
+        self.pool: Optional[KVPool] = None
+        self.restore_buckets: List[int] = []
+        self._jrestore = None
+        self._jpublish = None
+        if (prefix_cache_mb and prefix_cache_mb > 0 and self._chunk_dense
+                and self._cache_cap is not None
+                and self.kv_block >= 1
+                and self._cache_cap >= self.kv_block):
+            attn = {key: st for key, st in self._states.items()
+                    if isinstance(st, dict) and "k" in st and "v" in st
+                    and "pos" in st}
+            pool = KVPool(attn, block=self.kv_block,
+                          budget_bytes=int(prefix_cache_mb * (1 << 20)),
+                          metrics=self.metrics)
+            if attn and pool.capacity_blocks > 0:
+                self.pool = pool
+                # one restore/publish program per pow2 block-chain bucket;
+                # every bucket satisfies bucket*kv_block <= cache capacity,
+                # so the fused row write always fits the slot's cache
+                self.restore_buckets = pow2_buckets(
+                    self._cache_cap // self.kv_block)
+                self._jrestore = jax.jit(functools.partial(
+                    gather_blocks, block=self.kv_block))
+                # storage is donated: publish updates the pool in place
+                # instead of re-materializing the whole budget's worth of
+                # arrays per call; the caller rebinds pool.storage to the
+                # result immediately, so the consumed buffers are never
+                # touched again
+                self._jpublish = jax.jit(functools.partial(
+                    scatter_blocks, block=self.kv_block),
+                    donate_argnums=(4,))
+        if prefix_cache_mb and prefix_cache_mb > 0 and self.pool is None:
+            # the knob was set but the pool could not engage — without
+            # this the operator sees a phantom cache (banner/flags say
+            # on, every prompt still pays full prefill, no prefix_*
+            # instruments in /metrics)
+            warnings.warn(
+                f"prefix_cache_mb={prefix_cache_mb} requested but the "
+                "prefix KV pool is DISABLED: "
+                + ("the model has no attention KV cache to share"
+                   if not self._chunk_dense or self._cache_cap is None
+                   else f"kv_block={kv_block} exceeds "
+                        f"max_cache_len={self._cache_cap}"
+                   if self._cache_cap < max(self.kv_block, 1)
+                   else "the byte budget is smaller than two "
+                        f"{self.kv_block}-position blocks"),
+                RuntimeWarning, stacklevel=2)
         self._prefill_next = 0  # round-robin over prefilling slots
         self._emitted_this_iter = 0  # scheduler-thread-only tally
         m = self.metrics
@@ -251,6 +337,15 @@ class DecodeScheduler:
         self._m_prefill_chunk = m.histogram(
             "prefill_chunk_size", lo=1.0,
             hi=float(max(self.prefill_buckets or [1])) + 1, per_decade=12)
+        if self.pool is not None:
+            self._m_prefix_lookups = m.counter("prefix_cache_lookups_total")
+            self._m_prefix_hits = m.counter("prefix_cache_hits_total")
+            self._m_prefix_lookup_tokens = m.counter(
+                "prefix_cache_lookup_tokens_total")
+            self._m_prefix_hit_tokens = m.counter(
+                "prefix_cache_hit_tokens_total")
+            m.ratio("prefix_cache_hit_rate", self._m_prefix_hit_tokens,
+                    self._m_prefix_lookup_tokens)
 
     # -- model plumbing ----------------------------------------------------
     def _impl_items(self):
@@ -446,6 +541,67 @@ class DecodeScheduler:
     def _reset_slot_state(self, slot: int) -> None:
         self._states = self._jzero(self._states, device_index(slot))
 
+    # -- prefix KV reuse (kvpool.py) ---------------------------------------
+    def _try_restore(self, slot: int, seq: _ActiveSeq) -> None:
+        """Walk the prefix trie for the admitted prompt and restore the
+        longest cached block chain into the freshly-zeroed slot, advancing
+        ``seq.fed``/``pos`` past the hit so chunked prefill only runs the
+        cold suffix. The hit is capped one token short of the prompt: the
+        LAST prompt token must always run through the model to produce
+        the first output token's distribution."""
+        B = self.pool.block
+        max_hit = (len(seq.prompt) - 1) // B
+        self._m_prefix_lookups.inc()
+        self._m_prefix_lookup_tokens.inc(len(seq.prompt))
+        if max_hit < 1:
+            return
+        n_blk, ids, node = self.pool.match(seq.prompt, max_hit)
+        seq.pool_node = node  # holds one reference until the slot frees
+        if not n_blk:
+            return
+        bucket = next(b for b in self.restore_buckets if b >= n_blk)
+        idx = np.full((bucket,), SCRATCH_BLOCK, np.int32)
+        idx[:n_blk] = ids
+        self._states = self._jrestore(
+            self._states, device_index(slot), jnp.asarray(idx),
+            device_index(n_blk), self.pool.storage)
+        seq.fed = n_blk * B
+        self._m_prefix_hits.inc()
+        self._m_prefix_hit_tokens.inc(seq.fed)
+
+    def _release_pool(self, seq: _ActiveSeq) -> None:
+        """Drop the sequence's prefix-trie reference (every slot-freeing
+        path — finish, cancel, stop — must come through here, or the
+        matched blocks stay pinned against eviction forever)."""
+        if seq.pool_node is not None:
+            self.pool.release(seq.pool_node)
+            seq.pool_node = None
+
+    def _publish_prompt(self, slot: int, seq: _ActiveSeq) -> None:
+        """Index a finished sequence's prompt: insert its full blocks into
+        the trie (allocating pool blocks, LRU-evicting unreferenced ones
+        when full) and copy the slot's prefill-written cache rows into the
+        new storage rows. The missing part is always a contiguous suffix
+        of the prompt's block chain, covered by a greedy descending walk
+        over the pow2 buckets — so publish compiles the same bounded
+        program family as restore."""
+        B = self.pool.block
+        n_full = len(seq.prompt) // B
+        if n_full < 1:
+            return
+        start, new_ids = self.pool.insert(seq.prompt[:n_full * B])
+        off = 0
+        while off < len(new_ids):
+            b = max(k for k in self.restore_buckets
+                    if k <= len(new_ids) - off)
+            idx = np.zeros((b,), np.int32)
+            idx[:] = new_ids[off:off + b]
+            self.pool.storage = self._jpublish(
+                self._states, device_index(slot),
+                device_index(start + off), jnp.asarray(idx),
+                self.pool.storage)
+            off += b
+
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: Optional[int] = None,
@@ -468,7 +624,11 @@ class DecodeScheduler:
         if self._cache_cap is not None:
             needed = len(prompt_ids) + max(max_new_tokens - 1, 0)
             if needed > self._cache_cap:
-                raise ValueError(
+                # rejected up front (HTTP 413 at the serving layer), not
+                # admitted to die mid-decode on the attention layer's
+                # KV-overflow guard
+                self._m_rejected.inc()
+                raise PromptTooLongError(
                     f"prompt ({len(prompt_ids)}) + max_new_tokens "
                     f"({max_new_tokens}) needs a KV cache of {needed} but "
                     f"max_cache_len={self._cache_cap}")
@@ -526,6 +686,8 @@ class DecodeScheduler:
         # writer) has been joined above
         for i, seq in enumerate(self._slots):  # graftlint: disable=CC004
             if seq is not None:
+                if self.pool is not None:
+                    self._release_pool(seq)
                 seq.handle._finish(RuntimeError("scheduler stopped"))
                 self._slots[i] = None
 
@@ -534,10 +696,17 @@ class DecodeScheduler:
         for i, seq in enumerate(self._slots):
             if seq is not None and seq.handle.cancelled():
                 self._m_cancelled.inc()
+                if self.pool is not None:
+                    # a cancel during prefill still holds the restored
+                    # prefix's trie reference — releasing here is what
+                    # keeps refcounts leak-free (nothing is published:
+                    # the prompt may be half-written)
+                    self._release_pool(seq)
                 seq.handle._finish()  # partial tokens, caller already left
                 self._slots[i] = None
 
     def _admit(self) -> None:
+        admitted: List[Tuple[int, _ActiveSeq]] = []
         with self._cond:
             for i in range(self.n_slots):
                 if self._slots[i] is not None:
@@ -548,12 +717,21 @@ class DecodeScheduler:
                         self._m_cancelled.inc()
                         seq.handle._finish()
                         continue
-                    self._reset_slot_state(i)
                     self._slots[i] = seq
                     self._m_seqs.inc()
+                    admitted.append((i, seq))
                     break
             self._m_queue_depth.set(len(self._queue))
             self._m_active.set(sum(s is not None for s in self._slots))
+        # device work happens OUTSIDE the condvar: the slot-reset and
+        # prefix-restore dispatches (and a restore bucket's first-call
+        # compile, which can take seconds) must not stall every submit()
+        # caller blocked on _cond. _slots/_states/pool are scheduler-
+        # thread-only, so no lock is needed past the queue handoff.
+        for i, seq in admitted:
+            self._reset_slot_state(i)
+            if self.pool is not None:
+                self._try_restore(i, seq)
 
     def _consume(self, slot: int, seq: _ActiveSeq,
                  probs_row: np.ndarray) -> None:
@@ -575,6 +753,11 @@ class DecodeScheduler:
             self._m_ttft.record(now - h.t_submit)
         if (len(h.tokens) >= h.max_new_tokens
                 or (seq.eos_id is not None and tok == seq.eos_id)):
+            if self.pool is not None:
+                # retain the prompt's prefill-written blocks for the next
+                # request sharing this prefix, then drop our own pin
+                self._publish_prompt(slot, seq)
+                self._release_pool(seq)
             h._finish()
             self._m_latency.record(now - h.t_submit)
             self._slots[slot] = None

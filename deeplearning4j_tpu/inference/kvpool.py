@@ -1,0 +1,326 @@
+"""Prefix KV reuse: block-pooled KV store + radix-trie prefix index.
+
+Real serving traffic is dominated by shared prompt prefixes (system
+prompts, few-shot templates, chat history), yet every admitted sequence
+used to pay the full chunked prefill (engine.py) even when an identical
+prefix was computed seconds ago in another slot. This module is the
+block-level KV management of modern inference engines (vLLM's
+PagedAttention block tables, SGLang's RadixAttention prefix tree) adapted
+to this engine's per-slot *contiguous* cache layout:
+
+  - :class:`KVPool` — per-layer K/V storage carved into fixed-size blocks
+    of ``block`` positions, preallocated under a byte budget (index 0 is a
+    scratch block that absorbs padded writes and is never handed out).
+    Blocks are refcounted through the trie nodes that own them and
+    LRU-evicted (unreferenced leaves first) when the free list runs dry.
+  - a **radix/trie prefix index**: one node per full block of token ids,
+    children keyed by the block's token tuple, so a prefix lookup walks
+    the trie in O(prompt/block) dict hops and returns the longest chain
+    of cached blocks. Only COMPLETE blocks are indexed — a partial tail
+    block is never shared (its K/V would depend on tokens the next
+    request may not send).
+  - :func:`gather_blocks` / :func:`scatter_blocks` — the pure program
+    bodies the engine jits: restore gathers a block chain out of pool
+    storage into one slot's contiguous cache rows ``[0, n*block)`` via a
+    single fused take + ``dynamic_update_slice`` (bucketed by chain
+    length, same pow2 compile discipline as chunked prefill) and advances
+    the slot's ``pos`` past the hit; publish slices a finished prompt's
+    rows back out of the slot cache into pool blocks.
+
+Soundness: reuse is only valid for **pos-0-anchored prefixes**. Cached
+keys are stored pre-rotated at their absolute positions (RoPE commutes
+with the cache — nn/layers/attention.py), so a prefix starting at
+position 0 is bit-identical across requests and can be copied instead of
+recomputed; a mid-sequence match would need re-rotation and is not
+attempted. Restored rows are *copies* into the slot's private cache, so a
+slot never aliases pool storage — and pool writes go through functional
+``.at[idx].set`` updates, so a restore gather issued against the previous
+storage array still reads consistent data (structural copy-on-write: a
+live reader is never aliased by a writer).
+
+Threading: the pool's host-side metadata (trie, free list, refcounts) is
+owned by the engine's scheduler thread — every mutation happens between
+engine steps on that single thread, the same single-writer discipline
+``DecodeScheduler._slots`` uses — so it needs no lock of its own.
+"""
+from __future__ import annotations
+
+import heapq
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .metrics import MetricsRegistry
+
+# storage index 0 is the scratch block: padded restore lanes gather from
+# it and padded publish lanes scatter into it, so bucketed programs never
+# need a mask — real blocks are numbered from 1
+SCRATCH_BLOCK = 0
+
+
+class _Node:
+    """One full block of a cached prefix: ``key`` is the block's token
+    tuple (the edge label from the parent), ``block_id`` its storage row.
+    ``lock`` counts live sequences pinning this node (admission locks the
+    deepest matched node; publish pins its extension path while
+    allocating) — locked nodes and interior nodes are never evicted."""
+
+    __slots__ = ("key", "block_id", "parent", "children", "last_access",
+                 "lock")
+
+    def __init__(self, key: Tuple[int, ...], block_id: int,
+                 parent: Optional["_Node"]):
+        self.key = key
+        self.block_id = block_id
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.last_access = 0
+        self.lock = 0
+
+
+class KVPool:
+    """Refcounted block pool + trie prefix index over per-layer K/V.
+
+    ``attn_states``: the engine's attention state entries
+    (``{key: {"k": [n_slots, L, Hkv, Dh], "v": ..., "pos": ...}}``) —
+    only shapes/dtypes are read; storage is allocated fresh. The byte
+    budget covers EVERYTHING the pool allocates (scratch block included):
+    ``capacity_blocks`` usable blocks cost
+    ``(capacity_blocks + 1) * bytes_per_block <= budget_bytes``.
+    """
+
+    def __init__(self, attn_states: Dict, *, block: int, budget_bytes: int,
+                 metrics: Optional[MetricsRegistry] = None):
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        self.block = int(block)
+        self.budget_bytes = int(budget_bytes)
+        per_block = 0
+        shapes = {}
+        for key, st in attn_states.items():
+            row_shape = tuple(st["k"].shape[2:])  # (Hkv, Dh)
+            dtype = st["k"].dtype
+            shapes[key] = (row_shape, dtype)
+            per_block += 2 * self.block * int(jnp.dtype(dtype).itemsize) \
+                * int(math.prod(row_shape))
+        self.bytes_per_block = per_block
+        total = self.budget_bytes // per_block if per_block else 0
+        # one block of the budget is the scratch row
+        self.capacity_blocks = max(0, int(total) - 1)
+        self.storage: Dict = {}
+        if self.capacity_blocks > 0:
+            n = self.capacity_blocks + 1
+            self.storage = {
+                key: {"k": jnp.zeros((n, self.block) + row_shape, dtype),
+                      "v": jnp.zeros((n, self.block) + row_shape, dtype)}
+                for key, (row_shape, dtype) in shapes.items()}
+        self._free: List[int] = list(range(1, self.capacity_blocks + 1))
+        self._root = _Node((), SCRATCH_BLOCK, None)
+        self._clock = 0  # logical LRU clock (monotonic per pool op)
+        self._metrics = metrics
+        if metrics is not None:
+            self._m_evicted = metrics.counter(
+                "prefix_cache_evicted_blocks_total")
+            self._m_used = metrics.gauge("prefix_cache_used_bytes")
+            cap = metrics.gauge("prefix_cache_capacity_bytes")
+            cap.set((self.capacity_blocks + 1) * per_block
+                    if self.capacity_blocks else 0)
+
+    # -- host-side bookkeeping ---------------------------------------------
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    @property
+    def used_blocks(self) -> int:
+        return self.capacity_blocks - len(self._free)
+
+    @property
+    def used_bytes(self) -> int:
+        """Logical bytes held by indexed blocks (the eviction pressure
+        signal; allocation itself is fixed at capacity)."""
+        return self.used_blocks * self.bytes_per_block
+
+    def outstanding_refs(self) -> int:
+        """Total live sequence references across the trie — zero when no
+        admitted sequence holds a prefix pin (the cancel-leak invariant)."""
+        return sum(n.lock for n in self._walk())
+
+    def refcounts(self) -> Dict[int, int]:
+        """block_id -> live sequence references on its node."""
+        return {n.block_id: n.lock for n in self._walk() if n.lock}
+
+    def _walk(self):
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    # -- prefix lookup ------------------------------------------------------
+    def match(self, tokens: Sequence[int], max_blocks: int
+              ) -> Tuple[int, List[int], Optional[_Node]]:
+        """Longest cached prefix of ``tokens``, capped at ``max_blocks``
+        full blocks. Returns ``(n_blocks, block_ids, node)`` and takes one
+        reference on the deepest matched node (release with
+        :meth:`release` when the sequence leaves its slot); no hit returns
+        ``(0, [], None)`` and takes no reference."""
+        node, ids = self._root, []
+        B = self.block
+        while len(ids) < max_blocks:
+            child = node.children.get(
+                tuple(int(t) for t in tokens[len(ids) * B:(len(ids) + 1) * B]))
+            if child is None:
+                break
+            node = child
+            node.last_access = self._tick()
+            ids.append(node.block_id)
+        if not ids:
+            return 0, [], None
+        node.lock += 1
+        return len(ids), ids, node
+
+    def release(self, node: _Node) -> None:
+        if node.lock <= 0:
+            raise AssertionError("release() without a matching reference")
+        node.lock -= 1
+
+    # -- insertion / eviction ----------------------------------------------
+    def insert(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
+        """Index ``tokens`` (length a multiple of ``block``): walk the
+        existing prefix, then allocate blocks for the missing suffix.
+        Returns ``(start_block, new_block_ids)`` — the caller must copy
+        the slot's cache rows ``[start*block, (start+len(ids))*block)``
+        into those storage rows *before* the next admission can match
+        them (trivially true on the single scheduler thread). Allocation
+        is best-effort: when eviction cannot free a block (everything
+        referenced), the suffix is simply not cached."""
+        B = self.block
+        n_total = len(tokens) // B
+        node, i = self._root, 0
+        while i < n_total:
+            child = node.children.get(
+                tuple(int(t) for t in tokens[i * B:(i + 1) * B]))
+            if child is None:
+                break
+            node = child
+            node.last_access = self._tick()
+            i += 1
+        start, new_ids, pinned = i, [], []
+        if node is not self._root:
+            node.lock += 1  # pin the extension point against eviction
+            pinned.append(node)
+        try:
+            # amortized: free everything this publish needs in ONE trie
+            # walk instead of one walk per allocated block
+            need = (n_total - start) - len(self._free)
+            if need > 0:
+                self._evict_lru(need)
+            for j in range(start, n_total):
+                bid = self._alloc()
+                if bid is None:
+                    break
+                key = tuple(int(t) for t in tokens[j * B:(j + 1) * B])
+                child = _Node(key, bid, node)
+                node.children[key] = child
+                node = child
+                node.last_access = self._tick()
+                node.lock += 1  # keep the fresh chain out of eviction
+                pinned.append(node)
+                new_ids.append(bid)
+        finally:
+            for n in pinned:
+                n.lock -= 1
+        if self._metrics is not None:
+            self._m_used.set(self.used_bytes)
+        return start, new_ids
+
+    def _alloc(self) -> Optional[int]:
+        if not self._free:
+            self._evict_lru()
+        return self._free.pop() if self._free else None
+
+    def _evict_lru(self, want: int = 1) -> None:
+        """Free up to ``want`` blocks, least-recently-used unreferenced
+        LEAVES first, in one trie walk (a heap over the candidates;
+        a parent whose last child goes becomes a candidate itself).
+        Interior nodes are never evicted directly — their children would
+        become unreachable prefixes."""
+        heap = [(n.last_access, id(n), n) for n in self._walk()
+                if not n.children and not n.lock]
+        heapq.heapify(heap)
+        freed = 0
+        while heap and freed < want:
+            _, _, victim = heapq.heappop(heap)
+            parent = victim.parent
+            del parent.children[victim.key]
+            self._free.append(victim.block_id)
+            freed += 1
+            if parent is not self._root and not parent.children \
+                    and not parent.lock:
+                heapq.heappush(heap,
+                               (parent.last_access, id(parent), parent))
+        if freed and self._metrics is not None:
+            self._m_evicted.inc(freed)
+            self._m_used.set(self.used_bytes)
+
+
+# -- jitted program bodies (the engine jits these once per pow2 bucket) ----
+def gather_blocks(states, slot1, idx, nblk1, storage, *, block):
+    """Restore a cached prefix into one slot's contiguous cache rows.
+
+    ``idx``: int32 [bucket] pool block ids, padded past ``nblk1[0]`` with
+    :data:`SCRATCH_BLOCK` — the padded rows land at ``[nblk*block,
+    bucket*block)``, beyond the restored ``pos``, so they are causally
+    invisible and overwritten by the cold-suffix prefill exactly like
+    chunked-prefill padding. ``slot1``/``nblk1`` are 1-element int32
+    arrays (explicit transfers, the engine's transfer-guard contract).
+    One XLA program per idx-length bucket; returns the updated states.
+    """
+    slot = slot1[0]
+    nblk = nblk1[0]
+    out = dict(states)
+    for key, store in storage.items():
+        st = states[key]
+        nb = idx.shape[0]
+        rows_k = store["k"][idx].reshape((1, nb * block) + st["k"].shape[2:])
+        rows_v = store["v"][idx].reshape((1, nb * block) + st["v"].shape[2:])
+        kc = jax.lax.dynamic_update_slice(st["k"], rows_k, (slot, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(st["v"], rows_v, (slot, 0, 0, 0))
+        pos = jax.lax.dynamic_update_slice(
+            st["pos"], jnp.reshape(nblk * block, (1,)).astype(st["pos"].dtype),
+            (slot,))
+        out[key] = {**st, "k": kc, "v": vc, "pos": pos}
+    return out
+
+
+def scatter_blocks(states, slot1, start1, idx, storage, *, block):
+    """Publish one slot's prompt rows ``[start*block, (start+nb)*block)``
+    into pool storage rows ``idx`` (int32 [nb], exact — no padding: the
+    engine covers the new-block suffix with a greedy descending-bucket
+    walk, so every id is real). The update is functional ``.at[idx].set``
+    (copy-on-write semantics: a reader of the input arrays is never
+    aliased by the write); the engine jits this with the storage argument
+    DONATED so XLA updates the pool in place instead of re-materializing
+    the whole byte budget per call — safe because all restore gathers
+    against the old buffers were dispatched earlier on the same thread
+    and XLA orders them before the donated write. Returns the updated
+    storage pytree."""
+    slot = slot1[0]
+    start = start1[0]
+    new_storage = {}
+    for key, store in storage.items():
+        st = states[key]
+        nb = idx.shape[0]
+        tail = st["k"].shape[2:]
+        rows_k = jax.lax.dynamic_slice(
+            st["k"], (slot, start * block, 0, 0), (1, nb * block) + tail)
+        rows_v = jax.lax.dynamic_slice(
+            st["v"], (slot, start * block, 0, 0), (1, nb * block) + tail)
+        new_storage[key] = {
+            "k": store["k"].at[idx].set(rows_k.reshape((nb, block) + tail)),
+            "v": store["v"].at[idx].set(rows_v.reshape((nb, block) + tail)),
+        }
+    return new_storage

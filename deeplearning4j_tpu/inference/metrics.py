@@ -183,6 +183,9 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        # derived name -> (numerator, denominator) counters, computed at
+        # snapshot time (a stored value would go stale between scrapes)
+        self._ratios: Dict[str, tuple] = {}
         self._t0 = time.monotonic()
 
     def counter(self, name: str) -> Counter:
@@ -203,6 +206,14 @@ class MetricsRegistry:
                 self._histograms[name] = Histogram(name, **kw)
             return self._histograms[name]
 
+    def ratio(self, name: str, numerator: Counter,
+              denominator: Counter) -> None:
+        """Register a derived numerator/denominator gauge (e.g. the
+        prefix-cache hit rate = hit tokens / looked-up tokens). Evaluated
+        fresh at every snapshot; an empty denominator reads as 0.0."""
+        with self._lock:
+            self._ratios[name] = (numerator, denominator)
+
     def snapshot(self) -> dict:
         """One JSON-able view of everything — the `GET /metrics` body and
         the UI snapshot payload."""
@@ -210,6 +221,7 @@ class MetricsRegistry:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
+            ratios = dict(self._ratios)
         return {
             "uptime_sec": round(time.monotonic() - self._t0, 3),
             "counters": {n: c.value for n, c in sorted(counters.items())},
@@ -217,6 +229,9 @@ class MetricsRegistry:
                        for n, g in sorted(gauges.items())},
             "histograms": {n: h.snapshot()
                            for n, h in sorted(histograms.items())},
+            "ratios": {n: round(num.value / den.value, 6)
+                       if den.value else 0.0
+                       for n, (num, den) in sorted(ratios.items())},
         }
 
     def render_text(self) -> str:
@@ -229,6 +244,9 @@ class MetricsRegistry:
         for n, g in snap["gauges"].items():
             lines.append(f"# TYPE {n} gauge")
             lines.append(f"{n} {g['value']}")
+        for n, v in snap.get("ratios", {}).items():
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {v}")
         for n, h in snap["histograms"].items():
             lines.append(f"# TYPE {n} summary")
             if h.get("count"):

@@ -20,10 +20,16 @@ Generative serving: pass ``decode_vocab`` (the LM's vocabulary size) and
 the server additionally runs a `inference.DecodeScheduler` — slot-based
 continuous-batching decode with chunked prefill — behind `POST /generate`.
 ``prefill_chunk`` is the TTFT / decode-latency knob (`dl4j-tpu serve
---generate --prefill-chunk C`); the scheduler's metrics (TTFT, prefill
-tokens, chunk sizes, cancellations) land in the same registry as the
-request-path metrics, so `GET /metrics` and the UI `/serving` page show
-the whole hot path.
+--generate --prefill-chunk C`); ``prefix_cache_mb``/``kv_block``
+(`--prefix-cache-mb MB --kv-block B`) enable the block-pooled prefix KV
+cache (`inference/kvpool.py`) so repeated prompt prefixes restore from
+cached blocks instead of re-prefilling. The scheduler's metrics (TTFT,
+prefill tokens, chunk sizes, prefix hit rate, cancellations) land in the
+same registry as the request-path metrics, so `GET /metrics` and the UI
+`/serving` page show the whole hot path. Requests that cannot fit the KV
+cache (`len(prompt) + max_new_tokens - 1 > max_cache_len`) are rejected
+up front with HTTP 413 (counted in `decode_rejected_total`) instead of
+dying mid-decode on the attention layer's overflow guard.
 
 Endpoints:
   GET  /health            {"status": "ok", "model": "...", "params": N}
@@ -40,7 +46,8 @@ Endpoints:
                           -> {"tokens": [ids]}; 400 unless the server was
                           started with decode_vocab. A ?timeout_ms expiry
                           CANCELS the decode (slot reclaimed) -> HTTP 504;
-                          a full decode queue -> HTTP 503
+                          a full decode queue -> HTTP 503; a prompt that
+                          cannot fit the KV cache -> HTTP 413
 """
 from __future__ import annotations
 
@@ -54,7 +61,8 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from ..inference import (DecodeScheduler, MetricsRegistry, MicroBatcher,
-                         QueueFullError, RequestTimeoutError)
+                         PromptTooLongError, QueueFullError,
+                         RequestTimeoutError)
 from .streaming import RecordToDataSetConverter
 
 
@@ -67,6 +75,7 @@ class InferenceServer:
                  default_timeout_ms: Optional[float] = None,
                  decode_vocab: Optional[int] = None, decode_slots: int = 4,
                  prefill_chunk: int = 64, decode_queue: int = 64,
+                 prefix_cache_mb: float = 0.0, kv_block: int = 16,
                  metrics: Optional[MetricsRegistry] = None):
         if net is None:
             if model_path is None:
@@ -85,6 +94,8 @@ class InferenceServer:
         self.decode_slots = int(decode_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.decode_queue = int(decode_queue)
+        self.prefix_cache_mb = float(prefix_cache_mb)
+        self.kv_block = int(kv_block)
         self._decoder: Optional[DecodeScheduler] = None
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -179,6 +190,8 @@ class InferenceServer:
                 self.net, self.decode_vocab, n_slots=self.decode_slots,
                 max_queue=self.decode_queue,
                 prefill_chunk=self.prefill_chunk,
+                prefix_cache_mb=self.prefix_cache_mb,
+                kv_block=self.kv_block,
                 metrics=self.metrics).start()
         m_http = self.metrics.counter("http_requests_total")
         m_err = self.metrics.counter("http_errors_total")
@@ -248,6 +261,14 @@ class InferenceServer:
                             json.loads(raw.decode()), timeout_ms))
                     else:
                         self._send({"error": "not found"}, 404)
+                except PromptTooLongError as e:
+                    # the scheduler refuses prompts that cannot fit the
+                    # KV cache BEFORE queueing (no slot ever admitted a
+                    # request destined to die on the overflow guard);
+                    # 413 tells the client the payload itself is the
+                    # problem, unlike a retryable 503/504
+                    m_err.inc()
+                    self._send({"error": f"prompt too long: {e}"}, 413)
                 except TimeoutError as e:  # incl. RequestTimeoutError and
                     # decode-scheduler timeouts (the decode is cancelled
                     # by generate() before the error propagates here)

@@ -290,7 +290,9 @@ async function refresh() {
   document.getElementById('meta').innerText =
     'uptime: ' + (m.uptime_sec || 0) + 's';
   const c = m.counters || {}, h = m.histograms || {};
+  const r = m.ratios || {};
   const ttft = h.decode_time_to_first_token_sec, ck = h.prefill_chunk_size;
+  const lk = c.prefix_cache_lookup_tokens_total;
   if (c.prefill_tokens_total !== undefined || ttft)
     document.getElementById('decode').innerText =
       'decode: ' + (c.decode_tokens_total || 0) + ' tokens, ' +
@@ -298,6 +300,12 @@ async function refresh() {
       (ck && ck.count ? ' (chunk p50 ' + ck.p50 + ')' : '') +
       (ttft && ttft.count ? ', TTFT p50 ' +
         (ttft.p50 * 1000).toFixed(1) + 'ms' : '') +
+      (lk !== undefined ? ', prefix hit ' +
+        (100 * (r.prefix_cache_hit_rate || 0)).toFixed(1) + '% of ' +
+        lk + ' looked-up tokens' +
+        (c.prefix_cache_evicted_blocks_total ? ' (' +
+          c.prefix_cache_evicted_blocks_total + ' blocks evicted)' : '')
+        : '') +
       (c.decode_cancelled_total ? ', ' + c.decode_cancelled_total +
         ' cancelled' : '');
   let rows = '<tr><th>metric</th><th>value</th></tr>';

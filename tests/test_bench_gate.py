@@ -50,3 +50,22 @@ def test_renamed_field_is_reported_not_silently_skipped():
     rows = {"alexnet_cifar10": {"mfu_renamed": 0.25}}
     regs = bench.check_floors(rows)
     assert any("missing/non-numeric" in r for r in regs), regs
+
+
+def test_prefix_reuse_ttft_regression_is_caught():
+    """ISSUE 4 acceptance floor: a repeated prompt must reach its first
+    token in <= 1/4 the engine steps of a cold prefill — a prefix-cache
+    regression that slides the ratio up (e.g. restores stop matching and
+    the repeat pays half the cold prefill) must trip the gate, as must a
+    collapse in restored tokens."""
+    rows = {"prefix_reuse": {"ttft_steps_ratio": 0.5, "hit_tokens": 240}}
+    regs = bench.check_floors(rows)
+    assert any("ttft_steps_ratio" in r for r in regs), regs
+    rows = {"prefix_reuse": {"ttft_steps_ratio": 0.25, "hit_tokens": 0}}
+    regs = bench.check_floors(rows)
+    assert any("hit_tokens" in r for r in regs), regs
+
+
+def test_prefix_reuse_healthy_row_passes():
+    rows = {"prefix_reuse": {"ttft_steps_ratio": 0.25, "hit_tokens": 240}}
+    assert bench.check_floors(rows) == []

@@ -15,7 +15,8 @@ from pathlib import Path
 
 import numpy as np
 
-from deeplearning4j_tpu.analysis import (concurrency_rule_pack,
+from deeplearning4j_tpu.analysis import (CompileCounter,
+                                         concurrency_rule_pack,
                                          crosscheck_lock_order,
                                          jax_rule_pack, lock_audit)
 from deeplearning4j_tpu.analysis.concurrency_rules import (build_lock_graph,
@@ -69,7 +70,10 @@ def test_runtime_lock_orders_match_static_graph_on_live_serving():
     held->acquired edge between statically-known locks must be consistent
     (combined static+observed graph acyclic). The workload deliberately
     crosses the known lock layers: scheduler condvar -> metrics
-    instruments, batcher condvar -> metrics instruments."""
+    instruments, batcher condvar -> metrics instruments. The scheduler
+    runs with the prefix KV pool enabled, and the run must also respect
+    the jit-program budgets (decode/prefill/admit AND the kvpool
+    restore/publish families registered in CompileCounter.for_scheduler)."""
     mods, errors = load_modules(
         [Path(_DEFAULT_TARGET) / d for d in _THREADED_SCOPE])
     assert not errors
@@ -91,15 +95,24 @@ def test_runtime_lock_orders_match_static_graph_on_live_serving():
         net = ComputationGraph(conf).init()
         m = MetricsRegistry()
         eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                              prefix_cache_mb=1.0, kv_block=8,
                               metrics=m).start()
+        audit = CompileCounter.for_scheduler(eng)
         try:
             rng = np.random.default_rng(0)
-            handles = [eng.submit(list(rng.integers(0, V, n)), 3)
-                       for n in (9, 17, 4)]
+            repeat = list(rng.integers(0, V, 17))
+            handles = [eng.submit(p, 3)
+                       for p in ([list(rng.integers(0, V, 9)), repeat,
+                                  list(rng.integers(0, V, 4))])]
             for h in handles:
                 h.result(120)
+            eng.submit(repeat, 3).result(120)  # prefix hit -> restore
         finally:
             eng.stop()
+        audit.assert_within_budget()
+        assert audit.count("prefix_restore") >= 1
+        assert audit.count("prefix_publish") >= 1
+        assert m.counter("prefix_cache_hits_total").value >= 1
         mb = MicroBatcher(lambda a: a * 2, max_batch=8, metrics=m).start()
         try:
             assert (np.asarray(mb.predict(np.ones((2, 3)))) == 2.0).all()
