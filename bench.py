@@ -440,6 +440,82 @@ def bench_prefix_reuse(prompt_len=256, new_tokens=16, chunk=64, vocab=64,
     }
 
 
+def bench_trace_overhead(prompt_len=64, new_tokens=24, chunk=32, vocab=64,
+                         n_reqs=6, rounds=8) -> dict:
+    """Flight-recorder cost A/B (ISSUE 5 acceptance: tracing stays ON in
+    production, so it must cost <= 5% serving throughput). The SAME
+    transformer LM drives two decode schedulers — one with a disabled
+    recorder, one with an 8192-event ring recording the full span
+    taxonomy — interleaved best-of-``rounds`` so both sides see the same
+    host-load regime (the int8 bench's protocol). Also measures the raw
+    ring append rate, the recorder's intrinsic per-event cost.
+    Standalone-runnable:
+        python -c "import bench, json; print(json.dumps(bench.bench_trace_overhead()))"
+    """
+    from deeplearning4j_tpu.inference import (DecodeScheduler,
+                                              FlightRecorder,
+                                              MetricsRegistry)
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = transformer_lm(vocab_size=vocab, d_model=64, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = prompt_len + new_tokens
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(0, vocab, prompt_len))
+               for _ in range(n_reqs)]
+
+    def make(tracer):
+        eng = DecodeScheduler(net, vocab, n_slots=4, prefill_chunk=chunk,
+                              metrics=MetricsRegistry(),
+                              tracer=tracer).start()
+        for h in [eng.submit(p, 2) for p in prompts]:  # warm/compile
+            h.result(600)
+        return eng
+
+    def run_once(eng):
+        t0 = time.perf_counter()
+        for h in [eng.submit(p, new_tokens) for p in prompts]:
+            h.result(600)
+        return n_reqs * new_tokens / (time.perf_counter() - t0)
+
+    eng_off = make(FlightRecorder(0, enabled=False))
+    eng_on = make(FlightRecorder(8192))
+    try:
+        tps_off = tps_on = 0.0
+        for _ in range(rounds):  # interleaved A/B: host-load drift hits
+            tps_off = max(tps_off, run_once(eng_off))  # both sides alike
+            tps_on = max(tps_on, run_once(eng_on))
+        n_recorded = eng_on.tracer.snapshot()["total_recorded"]
+    finally:
+        eng_off.stop()
+        eng_on.stop()
+    rec = FlightRecorder(8192)
+    n_ev = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n_ev):
+        rec.instant("bench", slot=1)
+    ev_rate = n_ev / (time.perf_counter() - t0)
+    return {
+        "tokens_per_sec_untraced": round(tps_off, 1),
+        "tokens_per_sec_traced": round(tps_on, 1),
+        "throughput_ratio": round(tps_on / tps_off, 4),
+        "events_recorded": n_recorded,
+        "recorder_events_per_sec": round(ev_rate),
+        "recorder_ns_per_event": round(1e9 / ev_rate),
+        "note": f"{n_reqs} concurrent {prompt_len}-token prompts x "
+                f"{new_tokens} greedy tokens on a 2-block d64 LM, 4 "
+                "slots; traced = full span taxonomy into an 8192-event "
+                "ring, untraced = disabled recorder; best-of-"
+                f"{rounds} interleaved rounds (floor: ratio >= 0.95, "
+                "the <=5% tracing budget)",
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -929,6 +1005,12 @@ def main() -> None:
         WORKLOADS["prefix_reuse"] = bench_prefix_reuse()
     except Exception as e:
         WORKLOADS["prefix_reuse"] = {"error": str(e)}
+
+    # ---- serving: flight-recorder tracing-on-vs-off A/B (ISSUE 5) -------
+    try:
+        WORKLOADS["trace_overhead"] = bench_trace_overhead()
+    except Exception as e:
+        WORKLOADS["trace_overhead"] = {"error": str(e)}
 
     # ---- perf-regression gate vs committed floors (BENCH_FLOORS.json) ----
     regressions = check_floors(WORKLOADS)

@@ -12,6 +12,7 @@ Usage:
   dl4j-tpu predict --model model.zip --input data.csv [--output preds.csv]
   dl4j-tpu serve   --model model.zip [--port P] [--int8] [--no-batching]
                    [--batch-window-ms MS] [--queue-size N] [--timeout-ms MS]
+                   [--trace-buffer N]
                    [--generate [--vocab-size V] [--decode-slots N]
                     [--prefill-chunk C] [--prefix-cache-mb MB]
                     [--kv-block B]]
@@ -106,7 +107,8 @@ def cmd_serve(args) -> int:
               decode_slots=args.decode_slots,
               prefill_chunk=args.prefill_chunk,
               prefix_cache_mb=args.prefix_cache_mb,
-              kv_block=args.kv_block)
+              kv_block=args.kv_block,
+              trace_buffer=args.trace_buffer)
     if getattr(args, "int8", False):
         # artifact must carry calibration (nn/quantization.save_quantized);
         # weight quantization is rebuilt deterministically from the params
@@ -156,7 +158,9 @@ def cmd_serve(args) -> int:
           f"http://127.0.0.1:{server.port} "
           "(POST /predict, /predict/csv"
           + (", /generate" if args.generate else "")
-          + "; GET /health, /info, /metrics)")
+          + "; GET /health, /info, /metrics"
+          + (f", /trace[{args.trace_buffer} events]"
+             if args.trace_buffer else "") + ")")
     if args.once:  # test hook: start, report, stop
         server.stop()
         return 0
@@ -245,6 +249,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--kv-block", type=int, default=16,
                    help="positions per prefix-cache block (only full "
                         "blocks of a prompt are shared)")
+    s.add_argument("--trace-buffer", type=int, default=8192,
+                   help="span flight-recorder ring capacity (events) "
+                        "backing GET /trace and per-request timings; "
+                        "0 disables request-lifecycle tracing")
     s.add_argument("--once", action="store_true",
                    help="start and immediately stop (smoke test)")
     s.set_defaults(func=cmd_serve)

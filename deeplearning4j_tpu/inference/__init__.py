@@ -7,7 +7,10 @@ padded batches; `DecodeScheduler` continuously batches generative decode
 over the attention KV cache, reusing cached prompt prefixes through the
 block-pooled `KVPool` prefix index; `MetricsRegistry` records queue
 depth, batch occupancy, hit rates, and latency percentiles, exported at
-`GET /metrics`.
+`GET /metrics`; the `FlightRecorder` span flight recorder (`trace.py`)
+records every request's lifecycle — queued/restore/prefill/decode span
+trees plus scheduler instants — exported at `GET /trace` (JSON or
+Perfetto-loadable Chrome trace-event format).
 """
 from .batcher import (InferenceFuture, MicroBatcher, QueueFullError,
                       RequestTimeoutError, pow2_buckets)
@@ -15,8 +18,10 @@ from .engine import DecodeHandle, DecodeScheduler, PromptTooLongError
 from .kvpool import KVPool
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry)
+from .trace import FlightRecorder, default_recorder, new_request_id
 
-__all__ = ["Counter", "DecodeHandle", "DecodeScheduler", "Gauge",
-           "Histogram", "InferenceFuture", "KVPool", "MetricsRegistry",
-           "MicroBatcher", "PromptTooLongError", "QueueFullError",
-           "RequestTimeoutError", "default_registry", "pow2_buckets"]
+__all__ = ["Counter", "DecodeHandle", "DecodeScheduler", "FlightRecorder",
+           "Gauge", "Histogram", "InferenceFuture", "KVPool",
+           "MetricsRegistry", "MicroBatcher", "PromptTooLongError",
+           "QueueFullError", "RequestTimeoutError", "default_recorder",
+           "default_registry", "new_request_id", "pow2_buckets"]

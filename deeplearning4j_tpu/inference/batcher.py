@@ -33,6 +33,7 @@ import numpy as np
 
 from ..analysis.runtime import host_read
 from .metrics import MetricsRegistry, default_registry
+from .trace import FlightRecorder, default_recorder
 
 
 class QueueFullError(RuntimeError):
@@ -111,6 +112,7 @@ class MicroBatcher:
                  max_batch: int = 64, max_queue: int = 256,
                  batch_window_s: float = 0.002,
                  metrics: Optional[MetricsRegistry] = None,
+                 tracer: Optional[FlightRecorder] = None,
                  name: str = "batcher"):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -120,7 +122,14 @@ class MicroBatcher:
         self.batch_window_s = float(batch_window_s)
         self.buckets = pow2_buckets(self.max_batch)
         self.metrics = metrics if metrics is not None else default_registry()
+        # flight recorder (trace.py): one span per dispatched batch on
+        # this batcher's OWN track + reject instants, so a slow /predict
+        # is attributable to queueing vs the forward itself. The track
+        # is scoped per instance — two per-signature batchers sharing a
+        # recorder must not interleave same-name spans on one track
+        self.tracer = tracer if tracer is not None else default_recorder()
         self._name = name
+        self._track = name + self.tracer.track_scope(name)
         self._queue: List[_Request] = []
         self._cond = threading.Condition()
         self._running = False
@@ -153,6 +162,9 @@ class MicroBatcher:
                 raise RuntimeError("batcher is not running (call start())")
             if len(self._queue) >= self.max_queue:
                 self._m_rejected.inc()
+                self.tracer.instant("reject", track=self._track,
+                                    args={"reason": "queue_full",
+                                          "waiting": len(self._queue)})
                 raise QueueFullError(
                     f"queue full ({self.max_queue} requests waiting)")
             self._queue.append(req)
@@ -245,13 +257,21 @@ class MicroBatcher:
                     live.append(req)
             if not live:
                 continue
+            if self.tracer.enabled:  # keep tracing-off allocation-free
+                self.tracer.begin(
+                    "predict_batch", track=self._track,
+                    args={"requests": len(live),
+                          "rows": sum(r.x.shape[0] for r in live)})
             try:
                 outs = self._dispatch([r.x for r in live])
             except Exception as e:  # model failure fails the REQUESTS,
                 for req in live:    # never the dispatcher thread
                     req.future._fail(e)
+                self.tracer.end("predict_batch", track=self._track,
+                                args={"error": type(e).__name__})
                 continue
             done = time.monotonic()
+            self.tracer.end("predict_batch", track=self._track)
             for req, out in zip(live, outs):
                 self._m_latency.record(done - req.t_enqueue)
                 req.future._resolve(out)

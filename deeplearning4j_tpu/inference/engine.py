@@ -79,7 +79,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis.runtime import device_index, host_read
+from ..analysis.runtime import CompileCounter, device_index, host_read
 from ..models.sampling import sample_logits
 from ..nn.layers.recurrent import (BaseRecurrentImpl,
                                    _materialize_rnn_states)
@@ -87,6 +87,7 @@ from ..nn.multilayer import _compute_dtype_of
 from .batcher import QueueFullError, pow2_buckets
 from .kvpool import SCRATCH_BLOCK, KVPool, gather_blocks, scatter_blocks
 from .metrics import MetricsRegistry, default_registry
+from .trace import FlightRecorder, default_recorder, new_request_id
 
 # chunk buckets never go below this (a 3-token tail still pads to one
 # small program instead of compiling a 3-wide one-off); buckets smaller
@@ -105,20 +106,49 @@ class PromptTooLongError(ValueError):
 class DecodeHandle:
     """Completion handle for one submitted generation request."""
 
-    def __init__(self, prompt_len: int, max_new_tokens: int):
+    def __init__(self, prompt_len: int, max_new_tokens: int,
+                 request_id: Optional[str] = None):
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
+        self.request_id = request_id or new_request_id()
         self.tokens: List[int] = []
         self._done = threading.Event()
         self._cancel = threading.Event()
         self._error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
+        # lifecycle timestamps stamped by the scheduler thread: the
+        # request's wall time splits into four CONTIGUOUS phases —
+        # queued [submit, admitted], restore [admitted, restored] (slot
+        # reset + prefix-cache restore), prefill [restored, first token],
+        # decode [first token, done] — so the `timings()` breakdown sums
+        # to the end-to-end latency by construction
+        self.t_admitted: Optional[float] = None
+        self.t_restored: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
         # engine iterations this sequence was stepped before its first
         # token (the bench's TTFT-in-steps: prompt_len token-by-token,
         # ceil(prompt_len / chunk) chunked)
         self.steps_to_first_token: Optional[int] = None
+
+    def timings(self) -> Dict[str, float]:
+        """Per-phase wall-time breakdown (ms). Phases are contiguous
+        segments of [t_submit, t_done], so ``queue_ms + restore_ms +
+        prefill_ms + decode_ms == total_ms`` (a request cancelled before
+        a boundary reports 0 for the phases it never reached)."""
+        end = self.t_done if self.t_done is not None else time.monotonic()
+        admitted = self.t_admitted if self.t_admitted is not None else end
+        restored = self.t_restored if self.t_restored is not None \
+            else admitted
+        first = self.t_first_token if self.t_first_token is not None else end
+        first = max(first, restored)
+        return {
+            "queue_ms": round((admitted - self.t_submit) * 1e3, 3),
+            "restore_ms": round((restored - admitted) * 1e3, 3),
+            "prefill_ms": round((first - restored) * 1e3, 3),
+            "decode_ms": round((end - first) * 1e3, 3),
+            "total_ms": round((end - self.t_submit) * 1e3, 3),
+        }
 
     def _finish(self, err: Optional[BaseException] = None) -> None:
         self._error = err
@@ -206,6 +236,14 @@ class DecodeScheduler:
     engages for attention nets (pos-0-anchored KV prefixes; recurrent
     h/c state has no position-addressed rows to share).
 
+    ``tracer``: span flight recorder (`inference/trace.py`, default the
+    process-wide one). Every request's lifecycle is recorded — queued /
+    prefix_restore / prefill (per-chunk spans on the slot track) /
+    decode / finish-or-cancel, plus slot occupancy, compile, and
+    pool-eviction instants — as O(1) lock-free ring appends, cheap
+    enough to stay on in production. `GET /trace` on the serving server
+    and `DecodeHandle.timings()` read it back.
+
     ``transfer_guard``: device-residency audit mode. When set (e.g.
     "disallow"), every scheduler iteration runs under that thread-local
     ``jax.transfer_guard`` level: any *implicit* host<->device transfer in
@@ -219,6 +257,7 @@ class DecodeScheduler:
                  max_queue: int = 64, prefill_chunk: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
                  metrics: Optional[MetricsRegistry] = None,
+                 tracer: Optional[FlightRecorder] = None,
                  transfer_guard: Optional[str] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
@@ -228,6 +267,16 @@ class DecodeScheduler:
         self.max_queue = int(max_queue)
         self.prefill_chunk = int(prefill_chunk)
         self.metrics = metrics if metrics is not None else default_registry()
+        # span flight recorder (trace.py): every request's lifecycle is
+        # recorded as spans/instants — O(1) lock-free ring appends, cheap
+        # enough to default ON (the process-wide recorder). Tracks are
+        # scoped per scheduler instance: a second scheduler sharing this
+        # recorder must not interleave same-name spans on "scheduler"/
+        # "slot N" tracks (the export pairs B/E LIFO per track)
+        self.tracer = tracer if tracer is not None else default_recorder()
+        sfx = self.tracer.track_scope("engine")
+        self._sched_track = "scheduler" + sfx
+        self._slot_tracks = [f"slot {i}{sfx}" for i in range(self.n_slots)]
         self._graph = hasattr(net.conf, "vertices")  # facade detection
         self._dtype = _compute_dtype_of(net.conf.conf)
         self._cache_cap = self._min_cache_len()
@@ -284,7 +333,7 @@ class DecodeScheduler:
                     and "pos" in st}
             pool = KVPool(attn, block=self.kv_block,
                           budget_bytes=int(prefix_cache_mb * (1 << 20)),
-                          metrics=self.metrics)
+                          metrics=self.metrics, tracer=self.tracer)
             if attn and pool.capacity_blocks > 0:
                 self.pool = pool
                 # one restore/publish program per pow2 block-chain bucket;
@@ -346,6 +395,13 @@ class DecodeScheduler:
                 "prefix_cache_hit_tokens_total")
             m.ratio("prefix_cache_hit_rate", self._m_prefix_hit_tokens,
                     self._m_prefix_lookup_tokens)
+        # compile-event tracing: the scheduler polls its own program
+        # families' jit-cache sizes (the same CompileCounter budgets the
+        # tests assert) once per iteration and stamps an instant event
+        # whenever one grew — a chunk bucket's first-call compile shows
+        # up ON the trace timeline, right where the stall happened
+        self._compile_counter = CompileCounter.for_scheduler(self)
+        self._compile_seen: Dict[str, int] = {}
 
     # -- model plumbing ----------------------------------------------------
     def _impl_items(self):
@@ -606,7 +662,9 @@ class DecodeScheduler:
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None, seed: int = 0,
-               eos_id: Optional[int] = None) -> DecodeHandle:
+               eos_id: Optional[int] = None,
+               request_id: Optional[str] = None) -> DecodeHandle:
+        rid = request_id or new_request_id()
         if not len(prompt_ids):
             raise ValueError("prompt_ids must be non-empty")
         if max_new_tokens < 1:
@@ -628,11 +686,15 @@ class DecodeScheduler:
                 # admitted to die mid-decode on the attention layer's
                 # KV-overflow guard
                 self._m_rejected.inc()
+                self.tracer.instant("reject", req=rid, args={
+                    "request_id": rid, "reason": "prompt_too_long",
+                    "needed": needed, "cache": self._cache_cap})
                 raise PromptTooLongError(
                     f"prompt ({len(prompt_ids)}) + max_new_tokens "
                     f"({max_new_tokens}) needs a KV cache of {needed} but "
                     f"max_cache_len={self._cache_cap}")
-        handle = DecodeHandle(len(prompt_ids), max_new_tokens)
+        handle = DecodeHandle(len(prompt_ids), max_new_tokens,
+                              request_id=rid)
         seq = _ActiveSeq(handle, prompt_ids, temperature, top_k, top_p,
                          seed, eos_id)
         with self._cond:
@@ -640,25 +702,46 @@ class DecodeScheduler:
                 raise RuntimeError("scheduler is not running (call start())")
             if len(self._queue) >= self.max_queue:
                 self._m_rejected.inc()
+                self.tracer.instant("reject", req=rid, args={
+                    "request_id": rid, "reason": "queue_full",
+                    "waiting": len(self._queue)})
                 raise QueueFullError(
                     f"decode queue full ({self.max_queue} waiting)")
             self._queue.append(seq)
             self._m_queue_depth.set(len(self._queue))
+            # the request's first span opens while the queue lock is
+            # still held — the scheduler needs _cond to pop this seq, so
+            # its end("queued") can never be sequenced before this begin
+            self.tracer.begin("queued", req=rid,
+                              args={"prompt_tokens": len(seq.prompt),
+                                    "max_new_tokens": max_new_tokens})
             self._cond.notify()
+        return handle
+
+    def generate_handle(self, prompt_ids: Sequence[int],
+                        max_new_tokens: int,
+                        timeout: Optional[float] = 120.0,
+                        **kw) -> DecodeHandle:
+        """Blocking submit returning the COMPLETED handle (tokens plus
+        the request_id and per-phase `timings()` the serving layer echoes
+        back). A timed-out wait CANCELS the request (the slot is
+        reclaimed at the scheduler's next step instead of decoding to
+        max_new_tokens for a caller that already gave up) — the one
+        place this contract lives; `generate` and the HTTP `/generate`
+        route both come through here."""
+        handle = self.submit(prompt_ids, max_new_tokens, **kw)
+        try:
+            handle.result(timeout)
+        except TimeoutError:
+            handle.cancel()
+            raise
         return handle
 
     def generate(self, prompt_ids: Sequence[int], max_new_tokens: int,
                  timeout: Optional[float] = 120.0, **kw) -> List[int]:
-        """Blocking submit — drop-in for `generate_transformer` greedy.
-        A timed-out wait CANCELS the request (the slot is reclaimed at the
-        scheduler's next step instead of decoding to max_new_tokens for a
-        caller that already gave up)."""
-        handle = self.submit(prompt_ids, max_new_tokens, **kw)
-        try:
-            return handle.result(timeout)
-        except TimeoutError:
-            handle.cancel()
-            raise
+        """Blocking submit — drop-in for `generate_transformer` greedy."""
+        return self.generate_handle(prompt_ids, max_new_tokens,
+                                    timeout=timeout, **kw).tokens
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "DecodeScheduler":
@@ -679,6 +762,7 @@ class DecodeScheduler:
             self._cond.notify_all()
         for seq in pending:
             seq.handle._finish(RuntimeError("scheduler stopped"))
+            self._trace_done("cancel", seq)
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
@@ -689,9 +773,37 @@ class DecodeScheduler:
                 if self.pool is not None:
                     self._release_pool(seq)
                 seq.handle._finish(RuntimeError("scheduler stopped"))
+                self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
 
     # -- scheduler loop ----------------------------------------------------
+    def _trace_done(self, outcome: str, seq: _ActiveSeq,
+                    slot: Optional[int] = None) -> None:
+        """Terminal trace records for one request: close whichever phase
+        span is still open (a slot-resident request always has `prefill`
+        or `decode` open; a never-admitted one has `queued`), then stamp
+        one ``finish``/``cancel`` instant carrying the handle's full
+        timing breakdown — the record `request_summaries` scrapes. Call
+        AFTER `handle._finish()` so `timings()` sees t_done."""
+        h = seq.handle
+        rid = h.request_id
+        tr = self.tracer
+        if not tr.enabled:
+            return
+        if h.t_admitted is None:
+            tr.end("queued", req=rid)
+        elif h.t_first_token is None:
+            tr.end("prefill", req=rid, args={"fed_tokens": seq.fed})
+        else:
+            tr.end("decode", req=rid,
+                   args={"tokens": len(h.tokens), "iterations": seq.steps})
+        tr.instant(outcome, req=rid,
+                   args={"request_id": rid, "tokens": len(h.tokens),
+                         **h.timings()})
+        if slot is not None:
+            tr.instant("free", track=self._slot_tracks[slot],
+                       args={"request": rid})
+
     def _evict_cancelled(self) -> None:
         for i, seq in enumerate(self._slots):
             if seq is not None and seq.handle.cancelled():
@@ -703,10 +815,12 @@ class DecodeScheduler:
                     # the prompt may be half-written)
                     self._release_pool(seq)
                 seq.handle._finish()  # partial tokens, caller already left
+                self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
 
     def _admit(self) -> None:
         admitted: List[Tuple[int, _ActiveSeq]] = []
+        tr = self.tracer
         with self._cond:
             for i in range(self.n_slots):
                 if self._slots[i] is not None:
@@ -716,6 +830,7 @@ class DecodeScheduler:
                     if seq.handle.cancelled():  # gave up while queued
                         self._m_cancelled.inc()
                         seq.handle._finish()
+                        self._trace_done("cancel", seq)
                         continue
                     self._slots[i] = seq
                     self._m_seqs.inc()
@@ -729,9 +844,22 @@ class DecodeScheduler:
         # caller blocked on _cond. _slots/_states/pool are scheduler-
         # thread-only, so no lock is needed past the queue handoff.
         for i, seq in admitted:
+            h = seq.handle
+            rid = h.request_id
+            h.t_admitted = time.monotonic()
+            tr.end("queued", req=rid)
+            tr.instant("admit", track=self._slot_tracks[i],
+                       args={"request": rid})
+            tr.begin("prefix_restore", req=rid)
             self._reset_slot_state(i)
             if self.pool is not None:
                 self._try_restore(i, seq)
+            h.t_restored = time.monotonic()
+            tr.end("prefix_restore", req=rid,
+                   args={"hit_tokens": seq.fed, "slot": i})
+            tr.begin("prefill", req=rid,
+                     args={"prompt_tokens": len(seq.prompt),
+                           "restored_tokens": seq.fed, "slot": i})
 
     def _consume(self, slot: int, seq: _ActiveSeq,
                  probs_row: np.ndarray) -> None:
@@ -751,6 +879,11 @@ class DecodeScheduler:
             h.t_first_token = now
             h.steps_to_first_token = seq.steps
             self._m_ttft.record(now - h.t_submit)
+            # phase boundary on the request track: prompt ingestion is
+            # over the moment the first output token exists
+            self.tracer.end("prefill", req=h.request_id,
+                            args={"steps": seq.steps})
+            self.tracer.begin("decode", req=h.request_id)
         if (len(h.tokens) >= h.max_new_tokens
                 or (seq.eos_id is not None and tok == seq.eos_id)):
             if self.pool is not None:
@@ -759,6 +892,7 @@ class DecodeScheduler:
                 self._publish_prompt(slot, seq)
                 self._release_pool(seq)
             h._finish()
+            self._trace_done("finish", seq, slot=slot)
             self._m_latency.record(now - h.t_submit)
             self._slots[slot] = None
 
@@ -777,6 +911,11 @@ class DecodeScheduler:
                 continue  # no cache headroom: token-by-token fallback
             ids = np.zeros((bucket,), np.int32)
             ids[:n_real] = seq.prompt[seq.fed:seq.fed + n_real]
+            if self.tracer.enabled:  # keep tracing-off allocation-free
+                self.tracer.begin("prefill_chunk",
+                                  track=self._slot_tracks[i],
+                                  args={"request": seq.handle.request_id,
+                                        "bucket": bucket, "tokens": n_real})
             probs, self._states = self._jprefill(
                 self.net.params, self.net.variables,
                 device_index(i), jnp.asarray(ids),
@@ -787,6 +926,7 @@ class DecodeScheduler:
             self._m_prefill_chunk.record(n_real)
             if seq.sampling:  # final chunk: its output is the first token
                 self._consume(i, seq, host_read(probs))
+            self.tracer.end("prefill_chunk", track=self._slot_tracks[i])
             self._prefill_next = (i + 1) % self.n_slots
             return i
         return None
@@ -829,6 +969,9 @@ class DecodeScheduler:
             for i, seq in fed:
                 ids[i] = seq.next_input()
                 live[i] = True
+            if self.tracer.enabled:  # keep tracing-off allocation-free
+                self.tracer.begin("decode_step", track=self._sched_track,
+                                  args={"live_slots": len(fed)})
             probs, new_states = self._jstep(
                 self.net.params, self.net.variables, jnp.asarray(ids),
                 jnp.asarray(live), self._states)
@@ -842,11 +985,28 @@ class DecodeScheduler:
                 if not was_sampling and not seq.sampling:
                     continue  # still prefilling; output not sampled yet
                 self._consume(i, seq, probs[i])
+            self.tracer.end("decode_step", track=self._sched_track)
         if self._emitted_this_iter:
             self._m_tokens.inc(self._emitted_this_iter)
         self._m_occupancy.record(len(active))
         self._m_step_time.record(time.monotonic() - t0)
+        self._trace_compiles()
         return True
+
+    def _trace_compiles(self) -> None:
+        """Instant event per NEW XLA program: the per-family jit-cache
+        sizes (CompileCounter, the same counters the recompile-budget
+        tests assert) are polled once per iteration; growth means this
+        iteration paid a compile — stamped on the timeline so a
+        seconds-long TTFT outlier is attributable to the bucket that
+        compiled under it."""
+        if not self.tracer.enabled:
+            return
+        for fam, n in self._compile_counter.counts().items():
+            if n > self._compile_seen.get(fam, 0):
+                self._compile_seen[fam] = n
+                self.tracer.instant("compile", track=self._sched_track,
+                                    args={"family": fam, "programs": n})
 
     def _loop(self) -> None:
         while True:

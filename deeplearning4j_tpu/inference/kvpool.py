@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 
 from .metrics import MetricsRegistry
+from .trace import FlightRecorder
 
 # storage index 0 is the scratch block: padded restore lanes gather from
 # it and padded publish lanes scatter into it, so bucketed programs never
@@ -92,10 +93,14 @@ class KVPool:
     """
 
     def __init__(self, attn_states: Dict, *, block: int, budget_bytes: int,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 tracer: Optional[FlightRecorder] = None):
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
         self.block = int(block)
+        # flight recorder (trace.py): eviction/publish instants on the
+        # `kvpool` track; None (standalone pool) records nothing
+        self._tracer = tracer
         self.budget_bytes = int(budget_bytes)
         per_block = 0
         shapes = {}
@@ -235,6 +240,10 @@ class KVPool:
                 n.lock -= 1
         if self._metrics is not None:
             self._m_used.set(self.used_bytes)
+        if new_ids and self._tracer is not None:
+            self._tracer.instant("pool_publish", track="kvpool",
+                                 args={"blocks": len(new_ids),
+                                       "used_blocks": self.used_blocks})
         return start, new_ids
 
     def _alloc(self) -> Optional[int]:
@@ -265,6 +274,10 @@ class KVPool:
         if freed and self._metrics is not None:
             self._m_evicted.inc(freed)
             self._m_used.set(self.used_bytes)
+        if freed and self._tracer is not None:
+            self._tracer.instant("pool_evict", track="kvpool",
+                                 args={"blocks": freed,
+                                       "used_blocks": self.used_blocks})
 
 
 # -- jitted program bodies (the engine jits these once per pow2 bucket) ----

@@ -235,15 +235,26 @@ class MetricsRegistry:
         }
 
     def render_text(self) -> str:
-        """Prometheus-flavored text exposition (`/metrics?format=text`)."""
+        """Prometheus-flavored text exposition (`/metrics?format=text`).
+
+        Parity with the JSON snapshot: the text form used to drop the
+        saturation signals the JSON carries — gauge high-water marks,
+        histogram extremes, process uptime — so a Prometheus-only
+        consumer could not see that a queue ever peaked between scrapes.
+        Now every gauge also exposes ``{name}_max``, every non-empty
+        histogram ``{name}_min``/``{name}_max``, and the process its
+        ``uptime_sec``."""
         snap = self.snapshot()
-        lines = []
+        lines = ["# TYPE uptime_sec gauge",
+                 f"uptime_sec {snap['uptime_sec']}"]
         for n, v in snap["counters"].items():
             lines.append(f"# TYPE {n} counter")
             lines.append(f"{n} {v}")
         for n, g in snap["gauges"].items():
             lines.append(f"# TYPE {n} gauge")
             lines.append(f"{n} {g['value']}")
+            lines.append(f"# TYPE {n}_max gauge")
+            lines.append(f"{n}_max {g['max']}")
         for n, v in snap.get("ratios", {}).items():
             lines.append(f"# TYPE {n} gauge")
             lines.append(f"{n} {v}")
@@ -256,6 +267,8 @@ class MetricsRegistry:
                                 ("p99", "0.99")):
                     lines.append(f'{n}{{quantile="{frac}"}} {h[q]}')
                 lines.append(f"{n}_sum {h['sum']}")
+                lines.append(f"{n}_min {h['min']}")
+                lines.append(f"{n}_max {h['max']}")
             lines.append(f"{n}_count {h.get('count', 0)}")
         return "\n".join(lines) + "\n"
 

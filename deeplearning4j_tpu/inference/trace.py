@@ -1,0 +1,320 @@
+"""Request-lifecycle tracing: a span flight recorder for the serving stack.
+
+`inference/metrics.py` answers "how is the fleet doing" — counters,
+gauges, latency percentiles. It cannot answer "where did THIS request's
+time go": a p99 TTFT may be queueing in the batcher, waiting for a free
+slot, missing the prefix cache, or sitting behind another slot's prefill
+chunks, and aggregates collapse all four into one number. TensorFlow's
+runtime made the same move for its asynchronous executor — a built-in
+step-timeline layer (Abadi et al., arXiv 1605.08695 §5) — and this module
+is that layer for the decode scheduler: per-request causality, cheap
+enough to stay on in production.
+
+Design: a process-wide **flight recorder** — a fixed-capacity ring buffer
+of span/event records. Appends are O(1) and lock-free:
+
+  - the ring is preallocated (``[None] * capacity``) and never grows; an
+    append builds ONE record tuple and stores it at ``seq % capacity``,
+    overwriting the oldest record (flight-recorder semantics: the last N
+    events always survive, history beyond that is intentionally lost);
+  - the sequence numbers come from ``itertools.count()``, whose
+    ``__next__`` is atomic in CPython (C-level, and internally locked on
+    free-threaded builds) — concurrent writers (HTTP handler threads, the
+    batcher dispatcher, the scheduler loop) each claim a distinct slot
+    with no lock at all. Two writers a full ``capacity`` apart may target
+    the same ring index; the younger record wins, which is exactly the
+    overwrite semantics the ring already has. List item assignment is
+    atomic, so a reader never observes a torn record — at worst a
+    snapshot taken mid-write misses the very newest events.
+
+Record taxonomy (the span tree every request gets):
+
+  ``queued`` -> ``admit``(slot) -> ``prefix_restore``(hit_tokens) ->
+  ``prefill`` [with per-chunk ``prefill_chunk``(bucket) spans on the slot
+  track] -> ``decode``(iterations, tokens) -> ``finish``/``cancel``;
+  plus scheduler-level instants: slot ``admit``/``free`` occupancy
+  changes, ``pool_evict``/``pool_publish`` from the KV pool, ``compile``
+  events (via `analysis.runtime.CompileCounter` cache-size deltas), and
+  ``reject`` instants for backpressure 503s / 413s / 504s.
+
+Tracks: every record resolves to a named track at append time — a slot
+track (``slot N``), a request track (``request <id>``), or a named
+component track (``scheduler``, ``predict``, ``kvpool``, ``http``). The
+Chrome trace-event export groups slot tracks under one process and
+request tracks under another, so Perfetto renders the classic serving
+waterfall: one row per slot showing interleaved prefill chunks, one row
+per request showing its queued/prefill/decode life.
+
+Exports:
+  - ``snapshot(limit)``    -> JSON-able dict (``GET /trace?limit=N``)
+  - ``chrome_trace(limit)``-> Chrome trace-event JSON, Perfetto-loadable
+                              (``GET /trace?format=chrome``); every ``B``
+                              is closed by a matching ``E`` even when the
+                              ring wrapped mid-span (orphan begins are
+                              closed at the last timestamp, orphan ends
+                              dropped), and ``ts`` is monotonic per track
+  - ``request_summaries(limit)`` -> per-request phase timings (the UI
+                              ``/serving`` waterfall lines)
+  - ``python -m deeplearning4j_tpu.inference.trace dump --url ...``
+                              fetches a serving server's Chrome trace to
+                              a file for Perfetto's "Open trace file"
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["FlightRecorder", "default_recorder", "new_request_id"]
+
+# record tuple layout (kept positional: one tuple alloc per append)
+_SEQ, _TS, _PH, _NAME, _TRACK, _ARGS = range(6)
+
+_rid_counter = itertools.count(1)
+
+
+def new_request_id() -> str:
+    """Process-unique request id (``r000001``, ...): claimed lock-free
+    from an `itertools.count`, same atomicity argument as the ring."""
+    return f"r{next(_rid_counter):06d}"
+
+
+class FlightRecorder:
+    """Fixed-capacity ring buffer of span begin/end and instant events.
+
+    ``capacity``: how many records the ring holds (oldest overwritten
+    first). ``capacity <= 0`` or ``enabled=False`` builds a disabled
+    recorder whose append methods return immediately — the hot-path cost
+    of tracing-off is one attribute test.
+    """
+
+    def __init__(self, capacity: int = 8192, *, enabled: bool = True):
+        self.capacity = max(0, int(capacity))
+        self.enabled = bool(enabled) and self.capacity > 0
+        self._buf: List[Optional[tuple]] = [None] * self.capacity
+        self._seq = itertools.count()
+        self._scopes: Dict[str, int] = {}
+        self._t0 = time.monotonic()
+
+    def track_scope(self, kind: str) -> str:
+        """Track-name suffix disambiguating multiple instances of one
+        component kind writing to the SAME recorder (two per-signature
+        batchers, two schedulers on the process-wide recorder): the
+        first claimant gets "" (the pretty bare track names), later ones
+        " (2)", " (3)", ... — without this, same-name spans from two
+        writers interleave on one track and the export's LIFO pairing
+        crosses their begin/ends. Called at component construction, not
+        on the hot path."""
+        n = self._scopes.get(kind, 0) + 1
+        self._scopes[kind] = n
+        return "" if n == 1 else f" ({n})"
+
+    # -- hot path ----------------------------------------------------------
+    def _append(self, ph: str, name: str, req: Optional[str],
+                slot: Optional[int], track: Optional[str],
+                args: Optional[dict]) -> None:
+        if track is None:
+            if slot is not None:
+                track = f"slot {slot}"
+            elif req is not None:
+                track = f"request {req}"
+            else:
+                track = "scheduler"
+        seq = next(self._seq)  # atomic claim; no lock
+        self._buf[seq % self.capacity] = (
+            seq, time.monotonic(), ph, name, track, args)
+
+    def begin(self, name: str, req: Optional[str] = None,
+              slot: Optional[int] = None, track: Optional[str] = None,
+              args: Optional[dict] = None) -> None:
+        """Open a span on the resolved track (close with :meth:`end`)."""
+        if self.enabled:
+            self._append("B", name, req, slot, track, args)
+
+    def end(self, name: str, req: Optional[str] = None,
+            slot: Optional[int] = None, track: Optional[str] = None,
+            args: Optional[dict] = None) -> None:
+        if self.enabled:
+            self._append("E", name, req, slot, track, args)
+
+    def instant(self, name: str, req: Optional[str] = None,
+                slot: Optional[int] = None, track: Optional[str] = None,
+                args: Optional[dict] = None) -> None:
+        if self.enabled:
+            self._append("i", name, req, slot, track, args)
+
+    # -- read side ---------------------------------------------------------
+    def events(self, limit: Optional[int] = None) -> List[dict]:
+        """The surviving records, oldest first, as JSON-able dicts.
+        ``limit`` keeps only the newest N. Reading is lock-free too: one
+        list copy, then sort — records written while copying either make
+        it in whole or not at all (item assignment is atomic), never
+        torn. Sorted by TIMESTAMP (seq breaks ties): seq claim and
+        `time.monotonic()` stamp are two steps, so a preempted writer
+        can hold an older seq with a newer ts — ts order is the true
+        temporal order the exports guarantee per track."""
+        recs = [r for r in list(self._buf) if r is not None]
+        recs.sort(key=lambda r: (r[_TS], r[_SEQ]))
+        if limit is not None and limit > 0:
+            recs = recs[-limit:]
+        out = []
+        for r in recs:
+            e = {"seq": r[_SEQ], "ts": round(r[_TS] - self._t0, 6),
+                 "ph": r[_PH], "name": r[_NAME], "track": r[_TRACK]}
+            if r[_ARGS]:
+                e["args"] = r[_ARGS]
+            out.append(e)
+        return out
+
+    def snapshot(self, limit: Optional[int] = None) -> dict:
+        """``GET /trace`` body: the events plus ring accounting (how many
+        records ever written, how many the ring has since overwritten)."""
+        evs = self.events()
+        total = (max(e["seq"] for e in evs) + 1) if evs else 0
+        if limit is not None and limit > 0:
+            evs = evs[-limit:]
+        return {"capacity": self.capacity, "total_recorded": total,
+                "dropped": max(0, total - self.capacity),
+                "events": evs}
+
+    def clear(self) -> None:
+        """Reset the ring (tests / between bench rounds). Not safe
+        against concurrent writers — quiesce first."""
+        self._buf = [None] * self.capacity
+        self._seq = itertools.count()
+        self._t0 = time.monotonic()
+
+    # -- Chrome trace-event export -----------------------------------------
+    def chrome_trace(self, limit: Optional[int] = None) -> dict:
+        """Chrome trace-event JSON (Perfetto / chrome://tracing loadable).
+
+        Tracks map to (pid, tid): slot tracks under the ``decode slots``
+        process, request tracks under ``requests``, component tracks
+        under ``serving``. Ring wraparound can orphan one side of a span:
+        an ``E`` whose ``B`` was overwritten is dropped, a ``B`` whose
+        ``E`` is missing (still open, or overwritten) is closed at the
+        last exported timestamp — so every emitted ``B`` has a matching
+        ``E``, properly nested per track, with monotonic ``ts``."""
+        evs = self.events(limit)
+        tids: Dict[str, tuple] = {}
+        counters = {0: 0, 1: 0, 2: 0}
+
+        def tid_of(track: str) -> tuple:
+            if track not in tids:
+                pid = (1 if track.startswith("slot ")
+                       else 2 if track.startswith("request ") else 0)
+                counters[pid] += 1
+                tids[track] = (pid, counters[pid])
+            return tids[track]
+
+        out: List[dict] = []
+        stacks: Dict[tuple, List[dict]] = {}
+        last_ts = 0.0
+
+        def emit(ph: str, name: str, ts: float, pid: int, tid: int,
+                 args: Optional[dict]) -> dict:
+            e = {"name": name, "ph": ph, "ts": round(ts * 1e6, 1),
+                 "pid": pid, "tid": tid}
+            if ph == "i":
+                e["s"] = "t"  # thread-scoped instant
+            if args:
+                e["args"] = args
+            out.append(e)
+            return e
+
+        for ev in evs:
+            pid, tid = tid_of(ev["track"])
+            ts = ev["ts"]
+            last_ts = max(last_ts, ts)
+            args = ev.get("args")
+            if ev["ph"] == "B":
+                stacks.setdefault((pid, tid), []).append(
+                    emit("B", ev["name"], ts, pid, tid, args))
+            elif ev["ph"] == "E":
+                stack = stacks.get((pid, tid), [])
+                if not any(b["name"] == ev["name"] for b in stack):
+                    continue  # orphan end: its begin was overwritten
+                # close intervening opens first (their end was lost to
+                # the ring, or the writer died mid-span) to keep nesting
+                while stack and stack[-1]["name"] != ev["name"]:
+                    inner = stack.pop()
+                    emit("E", inner["name"], ts, pid, tid, None)
+                stack.pop()
+                emit("E", ev["name"], ts, pid, tid, args)
+            else:
+                emit("i", ev["name"], ts, pid, tid, args)
+        for (pid, tid), stack in stacks.items():
+            while stack:  # still-open spans close at the last timestamp
+                b = stack.pop()
+                emit("E", b["name"], last_ts, pid, tid, None)
+        meta = [{"name": "process_name", "ph": "M", "pid": p, "tid": 0,
+                 "args": {"name": label}}
+                for p, label in ((0, "serving"), (1, "decode slots"),
+                                 (2, "requests")) if counters[p]]
+        meta += [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                  "args": {"name": track}}
+                 for track, (pid, tid) in sorted(tids.items())]
+        return {"displayTimeUnit": "ms", "traceEvents": meta + out}
+
+    # -- waterfall summaries -----------------------------------------------
+    def request_summaries(self, limit: int = 16) -> List[dict]:
+        """The newest N completed requests' phase timings, oldest first —
+        scraped from the ``finish``/``cancel`` instants the scheduler
+        stamps with the handle's timing breakdown. Feeds the UI
+        ``/serving`` waterfall lines."""
+        done = [e for e in self.events()
+                if e["ph"] == "i" and e["name"] in ("finish", "cancel")
+                and e.get("args", {}).get("request_id")]
+        done = done[-max(1, limit):]
+        return [{"outcome": e["name"], **e["args"]} for e in done]
+
+
+_default: Optional[FlightRecorder] = None
+
+
+def default_recorder() -> FlightRecorder:
+    """Process-wide recorder for components not handed an explicit one
+    (same pattern as `metrics.default_registry`). Creation is idempotent
+    enough lock-free: a lost race leaks one empty ring, never records."""
+    global _default
+    if _default is None:
+        _default = FlightRecorder()
+    return _default
+
+
+# -- CLI: dump a serving server's trace for Perfetto ------------------------
+def main(argv=None) -> int:
+    import argparse
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.inference.trace",
+        description="Fetch a serving server's flight-recorder trace")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("dump", help="write the Chrome trace-event JSON "
+                                    "(load it at ui.perfetto.dev)")
+    d.add_argument("--url", default="http://127.0.0.1:8080",
+                   help="serving server base URL")
+    d.add_argument("--out", default="trace.json",
+                   help="output path (Chrome trace-event JSON)")
+    d.add_argument("--limit", type=int, default=0,
+                   help="newest N events only (0 = everything surviving)")
+    args = p.parse_args(argv)
+    url = f"{args.url.rstrip('/')}/trace?format=chrome"
+    if args.limit:
+        url += f"&limit={args.limit}"
+    trace = json.loads(urllib.request.urlopen(url).read())
+    with open(args.out, "w") as fh:
+        json.dump(trace, fh)
+    n = len(trace.get("traceEvents", []))
+    tracks = len({(e.get("pid"), e.get("tid")) for e in
+                  trace.get("traceEvents", []) if e.get("ph") != "M"})
+    print(f"{args.out}: {n} events on {tracks} tracks "
+          "(open at https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

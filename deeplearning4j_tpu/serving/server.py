@@ -31,11 +31,25 @@ cache (`len(prompt) + max_new_tokens - 1 > max_cache_len`) are rejected
 up front with HTTP 413 (counted in `decode_rejected_total`) instead of
 dying mid-decode on the attention layer's overflow guard.
 
+Observability (`inference/trace.py`): the server owns a span flight
+recorder written from the HTTP layer, batcher, decode scheduler, and KV
+pool. Every POST carries an `X-Request-Id` response header (a well-formed
+client-supplied id becomes the prefix of a server-uniquified one, so
+retries sharing an id never merge onto one trace track), error bodies
+quote the id, `/generate`
+responses include a per-phase ``timings`` breakdown (queue/restore/
+prefill/decode, summing to the end-to-end latency), and `GET /trace`
+exports the ring — structured JSON or Chrome trace-event format
+(`?format=chrome`, Perfetto-loadable; `python -m
+deeplearning4j_tpu.inference.trace dump` fetches it to a file).
+
 Endpoints:
   GET  /health            {"status": "ok", "model": "...", "params": N}
   GET  /info              model summary + config JSON
   GET  /metrics           SLO metrics snapshot (?format=text for a
                           Prometheus-flavored exposition)
+  GET  /trace             flight-recorder dump (?limit=N newest events;
+                          ?format=chrome for Perfetto / chrome://tracing)
   POST /predict           {"data": [[...], ...]}  -> probabilities + argmax
                           (?timeout_ms=N sets the request deadline; an
                           expired request gets HTTP 504, a full queue 503)
@@ -43,15 +57,18 @@ Endpoints:
                           RecordToDataSetConverter (label column ignored)
   POST /generate          {"prompt": [ids], "max_new_tokens": N,
                           "temperature"/"top_k"/"top_p"/"seed"/"eos_id"?}
-                          -> {"tokens": [ids]}; 400 unless the server was
-                          started with decode_vocab. A ?timeout_ms expiry
-                          CANCELS the decode (slot reclaimed) -> HTTP 504;
-                          a full decode queue -> HTTP 503; a prompt that
-                          cannot fit the KV cache -> HTTP 413
+                          -> {"tokens": [ids], "request_id": "...",
+                          "timings": {queue_ms, restore_ms, prefill_ms,
+                          decode_ms, total_ms}}; 400 unless the server
+                          was started with decode_vocab. A ?timeout_ms
+                          expiry CANCELS the decode (slot reclaimed) ->
+                          HTTP 504; a full decode queue -> HTTP 503; a
+                          prompt that cannot fit the KV cache -> HTTP 413
 """
 from __future__ import annotations
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
@@ -63,7 +80,16 @@ import numpy as np
 from ..inference import (DecodeScheduler, MetricsRegistry, MicroBatcher,
                          PromptTooLongError, QueueFullError,
                          RequestTimeoutError)
+from ..inference.trace import FlightRecorder, new_request_id
 from .streaming import RecordToDataSetConverter
+
+# what a client-supplied X-Request-Id may look like before we echo it
+# back into a response HEADER: obs-folded request headers reach
+# `self.headers.get()` with embedded CR/LF, and `send_header` writes the
+# value verbatim — an unvalidated id is a response-header injection (and
+# an unbounded string in every trace record). Anything else gets a
+# server-generated id instead.
+_REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._:\-]{1,128}")
 
 
 class InferenceServer:
@@ -76,7 +102,9 @@ class InferenceServer:
                  decode_vocab: Optional[int] = None, decode_slots: int = 4,
                  prefill_chunk: int = 64, decode_queue: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 trace_buffer: int = 8192,
+                 tracer: Optional[FlightRecorder] = None):
         if net is None:
             if model_path is None:
                 raise ValueError("pass a net or a model_path")
@@ -98,6 +126,11 @@ class InferenceServer:
         self.kv_block = int(kv_block)
         self._decoder: Optional[DecodeScheduler] = None
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # per-server flight recorder (like the per-server MetricsRegistry:
+        # one source of truth this server's `GET /trace` reads back);
+        # trace_buffer=0 disables recording entirely (`--trace-buffer 0`)
+        self.tracer = tracer if tracer is not None else FlightRecorder(
+            trace_buffer, enabled=trace_buffer > 0)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._port = port
@@ -137,7 +170,8 @@ class InferenceServer:
                     self._net_output,
                     max_batch=self.max_batch, max_queue=self.max_queue,
                     batch_window_s=self.batch_window_ms / 1e3,
-                    metrics=self.metrics, name="predict").start()
+                    metrics=self.metrics, tracer=self.tracer,
+                    name="predict").start()
                 self._batchers[sig] = b
             return b
 
@@ -167,8 +201,8 @@ class InferenceServer:
             if out.ndim >= 2 and out.shape[-1] > 0 else [],
         }
 
-    def _generate(self, payload: dict,
-                  timeout_ms: Optional[float]) -> dict:
+    def _generate(self, payload: dict, timeout_ms: Optional[float],
+                  request_id: Optional[str] = None) -> dict:
         if self._decoder is None:
             raise ValueError("generation is disabled: start the server "
                              "with decode_vocab (CLI: --generate)")
@@ -176,12 +210,16 @@ class InferenceServer:
             timeout_ms = self.default_timeout_ms
         kw = {k: payload[k] for k in ("temperature", "top_k", "top_p",
                                       "seed", "eos_id") if k in payload}
-        tokens = self._decoder.generate(
+        handle = self._decoder.generate_handle(
             [int(t) for t in payload["prompt"]],
             int(payload.get("max_new_tokens", 16)),
             timeout=timeout_ms / 1e3 if timeout_ms is not None else 120.0,
-            **kw)
-        return {"tokens": tokens}
+            request_id=request_id, **kw)
+        # the per-request observability payload: the id the client can
+        # quote (X-Request-Id carries it too) and the phase breakdown
+        # whose four segments sum to the end-to-end latency
+        return {"tokens": handle.tokens, "request_id": handle.request_id,
+                "timings": handle.timings()}
 
     def start(self) -> "InferenceServer":
         server = self
@@ -192,7 +230,7 @@ class InferenceServer:
                 prefill_chunk=self.prefill_chunk,
                 prefix_cache_mb=self.prefix_cache_mb,
                 kv_block=self.kv_block,
-                metrics=self.metrics).start()
+                metrics=self.metrics, tracer=self.tracer).start()
         m_http = self.metrics.counter("http_requests_total")
         m_err = self.metrics.counter("http_errors_total")
 
@@ -200,12 +238,17 @@ class InferenceServer:
             def log_message(self, *args):  # quiet
                 pass
 
-            def _send(self, obj, code=200, content_type="application/json"):
+            def _send(self, obj, code=200, content_type="application/json",
+                      request_id=None):
                 body = (obj if isinstance(obj, bytes)
                         else json.dumps(obj).encode())
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                if request_id:
+                    # clients quote this id when reporting a slow/failed
+                    # request; it keys straight into GET /trace
+                    self.send_header("X-Request-Id", request_id)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -228,6 +271,18 @@ class InferenceServer:
                                    content_type="text/plain; version=0.0.4")
                     else:
                         self._send(server.metrics.snapshot())
+                elif url.path == "/trace":
+                    q = parse_qs(url.query)
+                    try:
+                        limit = int(q.get("limit", ["0"])[0]) or None
+                    except ValueError:
+                        return self._send(
+                            {"error": "limit must be an integer"}, 400)
+                    if q.get("format", [""])[0] == "chrome":
+                        # Perfetto / chrome://tracing loadable
+                        self._send(server.tracer.chrome_trace(limit=limit))
+                    else:
+                        self._send(server.tracer.snapshot(limit=limit))
                 else:
                     self._send({"error": "not found"}, 404)
 
@@ -235,6 +290,18 @@ class InferenceServer:
                 m_http.inc()
                 url = urlparse(self.path)
                 q = parse_qs(url.query)
+                # every POST gets a request id; a well-formed
+                # client-supplied X-Request-Id is kept as the PREFIX of
+                # a server-uniquified id (a client retrying with the
+                # same id must not merge two live requests onto one
+                # trace track — stack-paired B/E spans would garble).
+                # The id rides the trace spans, the response header, and
+                # every error body — "my request was slow" becomes
+                # "request r000123 was slow", greppable in /trace
+                rid = self.headers.get("X-Request-Id") or ""
+                rid = (f"{rid}.{new_request_id()}"
+                       if _REQUEST_ID_RE.fullmatch(rid)
+                       else new_request_id())
                 timeout_ms = None
                 if "timeout_ms" in q:
                     try:
@@ -242,7 +309,8 @@ class InferenceServer:
                     except ValueError:
                         m_err.inc()
                         return self._send(
-                            {"error": "timeout_ms must be a number"}, 400)
+                            {"error": "timeout_ms must be a number",
+                             "request_id": rid}, 400, request_id=rid)
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n)
                 try:
@@ -251,16 +319,20 @@ class InferenceServer:
                                 raw.decode().strip().splitlines() if line.strip()]
                         ds = server.converter.convert(rows)
                         self._send(server._predict(np.asarray(ds.features),
-                                                   timeout_ms))
+                                                   timeout_ms),
+                                   request_id=rid)
                     elif url.path == "/predict":
                         payload = json.loads(raw.decode())
                         arr = np.asarray(payload["data"], np.float32)
-                        self._send(server._predict(arr, timeout_ms))
+                        self._send(server._predict(arr, timeout_ms),
+                                   request_id=rid)
                     elif url.path == "/generate":
                         self._send(server._generate(
-                            json.loads(raw.decode()), timeout_ms))
+                            json.loads(raw.decode()), timeout_ms,
+                            request_id=rid), request_id=rid)
                     else:
-                        self._send({"error": "not found"}, 404)
+                        self._send({"error": "not found"}, 404,
+                                   request_id=rid)
                 except PromptTooLongError as e:
                     # the scheduler refuses prompts that cannot fit the
                     # KV cache BEFORE queueing (no slot ever admitted a
@@ -268,18 +340,26 @@ class InferenceServer:
                     # 413 tells the client the payload itself is the
                     # problem, unlike a retryable 503/504
                     m_err.inc()
-                    self._send({"error": f"prompt too long: {e}"}, 413)
+                    self._send({"error": f"prompt too long: {e}",
+                                "request_id": rid}, 413, request_id=rid)
                 except TimeoutError as e:  # incl. RequestTimeoutError and
                     # decode-scheduler timeouts (the decode is cancelled
                     # by generate() before the error propagates here)
                     m_err.inc()
-                    self._send({"error": f"deadline exceeded: {e}"}, 504)
+                    server.tracer.instant("reject", track="http", args={
+                        "request_id": rid, "reason": "timeout_504"})
+                    self._send({"error": f"deadline exceeded: {e}",
+                                "request_id": rid}, 504, request_id=rid)
                 except QueueFullError as e:
                     m_err.inc()
-                    self._send({"error": f"over capacity: {e}"}, 503)
+                    server.tracer.instant("reject", track="http", args={
+                        "request_id": rid, "reason": "backpressure_503"})
+                    self._send({"error": f"over capacity: {e}",
+                                "request_id": rid}, 503, request_id=rid)
                 except Exception as e:  # bad payloads must not kill the server
                     m_err.inc()
-                    self._send({"error": str(e)}, 400)
+                    self._send({"error": str(e), "request_id": rid}, 400,
+                               request_id=rid)
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,

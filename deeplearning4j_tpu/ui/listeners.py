@@ -207,16 +207,24 @@ def post_tsne(server_url: str, coords, labels=None,
 
 
 def post_serving_metrics(server_url: str, metrics,
-                         session_id: str = "default") -> None:
+                         session_id: str = "default", tracer=None) -> None:
     """Upload a serving SLO metrics snapshot for the /serving view.
 
     ``metrics``: an `inference.MetricsRegistry` (snapshotted here) or an
     already-built snapshot dict — so both a live `InferenceServer`
     (`post_serving_metrics(url, srv.metrics)`) and an offline recorder can
-    feed the page. Same transport as every other listener in this module."""
+    feed the page. Same transport as every other listener in this module.
+
+    ``tracer``: optionally an `inference.FlightRecorder` (e.g.
+    ``srv.tracer``) — its newest per-request phase timings ride along and
+    render as the /serving page's trace-waterfall lines (one bar per
+    recent request: queue | restore | prefill | decode)."""
     snap = metrics.snapshot() if hasattr(metrics, "snapshot") else dict(metrics)
+    payload = {"metrics": snap}
+    if tracer is not None:
+        payload["trace"] = tracer.request_summaries(12)
     _post(f"{server_url.rstrip('/')}/serving/update?sid={session_id}",
-          {"metrics": snap})
+          payload)
 
 
 def post_word_vectors(server_url: str, word_vectors,

@@ -281,14 +281,46 @@ _SERVING_PAGE = """<!DOCTYPE html>
 <h2>Serving SLO metrics</h2>
 <div id="meta"></div>
 <div id="decode" style="color:#555"></div>
+<div id="trace" style="font-family:monospace;font-size:12px"></div>
 <table id="t" border="1" cellpadding="4" style="border-collapse:collapse">
 </table>
 <script>
+function esc(s) {
+  // request ids can be CLIENT-SUPPLIED (X-Request-Id is honored), so
+  // they must never reach innerHTML unescaped
+  return String(s).replace(/[&<>"']/g, c => ({'&': '&amp;', '<': '&lt;',
+    '>': '&gt;', '"': '&quot;', "'": '&#39;'}[c]));
+}
+function waterfall(r) {
+  // one summary line per recent request: phase widths proportional to
+  // the request's share of the slowest request shown
+  const phases = [['queue_ms', '#bbb'], ['restore_ms', '#9c6'],
+                  ['prefill_ms', '#69c'], ['decode_ms', '#c96']];
+  const total = r.total_ms || 0.001;
+  let bars = '';
+  for (const [k, col] of phases) {
+    const w = Math.round(260 * (r[k] || 0) / waterfall.max);
+    if (w > 0) bars += '<span style="display:inline-block;height:10px;' +
+      'width:' + w + 'px;background:' + col + '" title="' + k + '=' +
+      (+r[k] || 0) + 'ms"></span>';
+  }
+  return '<div>' + esc(r.request_id) + ' ' + (r.outcome === 'cancel' ?
+    'CANCELLED' : (+r.tokens || 0) + ' tok') + ' ' + total.toFixed(1) +
+    'ms ' + bars + ' <span style="color:#888">queue ' +
+    (+r.queue_ms || 0) + ' | restore ' + (+r.restore_ms || 0) +
+    ' | prefill ' + (+r.prefill_ms || 0) + ' | decode ' +
+    (+r.decode_ms || 0) + '</span></div>';
+}
 async function refresh() {
   const d = await (await fetch('/serving/data' + location.search)).json();
   const m = d.metrics || {};
   document.getElementById('meta').innerText =
     'uptime: ' + (m.uptime_sec || 0) + 's';
+  const tr = d.trace || [];
+  waterfall.max = Math.max(0.001, ...tr.map(r => r.total_ms || 0));
+  document.getElementById('trace').innerHTML = tr.length ?
+    '<p><b>recent requests</b> (queue&#9632;restore&#9632;prefill' +
+    '&#9632;decode)</p>' + tr.map(waterfall).join('') : '';
   const c = m.counters || {}, h = m.histograms || {};
   const r = m.ratios || {};
   const ttft = h.decode_time_to_first_token_sec, ck = h.prefill_chunk_size;

@@ -1,4 +1,4 @@
-"""Load-generate against the batched inference server (ISSUE 1).
+"""Load-generate against the batched inference server (ISSUE 1 + 5).
 
 Starts an `InferenceServer` (continuous micro-batching ON), drives it with
 N closed-loop HTTP client threads, then prints the SLO picture straight
@@ -7,8 +7,16 @@ high-water mark, and p50/p95/p99 end-to-end latency. Run `--compare` to
 also measure the lock-serialized fallback on the same model (the
 pre-batching serving path) and print the speedup.
 
+`--generate` drives the continuous-batching decode scheduler instead
+(`POST /generate` on a small transformer LM): each response's per-phase
+``timings`` breakdown is printed as a waterfall line, and `--trace-out
+FILE` dumps the server's flight recorder as Chrome trace-event JSON —
+open it at https://ui.perfetto.dev to see one track per decode slot
+(interleaved prefill chunks) and one per request (queued/prefill/decode).
+
     python examples/serving_load_test.py            # batched only
     python examples/serving_load_test.py --compare  # batched vs serialized
+    python examples/serving_load_test.py --generate --trace-out trace.json
 """
 import argparse
 import json
@@ -60,6 +68,84 @@ def _drive(server, n_threads, reqs_each, body):
     return n_threads * reqs_each / elapsed, errors
 
 
+def _make_lm(vocab=32, cache=96):
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    conf = transformer_lm(vocab_size=vocab, d_model=32, n_heads=2,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = cache
+    return ComputationGraph(conf).init()
+
+
+def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
+                  trace_out=None, verbose=True):
+    """Drive POST /generate and show where each request's time went."""
+    vocab = 32
+    net = _make_lm(vocab, cache=prompt_len + new_tokens)
+    srv = InferenceServer(net=net, decode_vocab=vocab, decode_slots=4,
+                          prefill_chunk=16, prefix_cache_mb=16,
+                          kv_block=8).start()
+    rng = np.random.default_rng(0)
+    results, errors = [], []
+    # prompts pre-built on the main thread (numpy Generators are not
+    # thread-safe); a few repeats so the prefix cache has something to hit
+    bodies = [json.dumps(
+        {"prompt": rng.integers(0, vocab, prompt_len).tolist(),
+         "max_new_tokens": new_tokens}).encode()
+        for _ in range(max(1, n_threads * reqs_each // 2))]
+
+    def client(k):
+        for i in range(reqs_each):
+            # global index: threads walk DIFFERENT slices of the prompt
+            # set, so each prompt is sent ~twice across the run (the
+            # prefix-cache repeat mix)
+            try:
+                results.append(_post(srv.port, "/generate",
+                                     bodies[(k * reqs_each + i)
+                                            % len(bodies)]))
+            except Exception as e:
+                errors.append(repr(e))
+
+    try:
+        # warm the program families so the timed run is compile-free
+        _post(srv.port, "/generate", json.dumps(
+            {"prompt": rng.integers(0, vocab, prompt_len).tolist(),
+             "max_new_tokens": 2}).encode())
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if trace_out:
+            trace = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/trace?format=chrome").read())
+            with open(trace_out, "w") as fh:
+                json.dump(trace, fh)
+    finally:
+        srv.stop()
+    assert not errors, errors
+    if verbose:
+        tok_s = len(results) * new_tokens / elapsed
+        print(f"generate:   {len(results)} requests, {tok_s:8.1f} tok/s")
+        for r in results[-6:]:  # waterfall: where each request's time went
+            t = r["timings"]
+            print(f"  {r['request_id']}  total {t['total_ms']:7.1f}ms = "
+                  f"queue {t['queue_ms']:.1f} + restore {t['restore_ms']:.1f}"
+                  f" + prefill {t['prefill_ms']:.1f} + decode "
+                  f"{t['decode_ms']:.1f}")
+        if trace_out:
+            n = len(trace.get("traceEvents", []))
+            print(f"trace:      {n} events -> {trace_out} "
+                  "(open at https://ui.perfetto.dev)")
+    return results
+
+
 def main(n_threads=8, reqs_each=10, rows=8, compare=False, verbose=True):
     net = _make_net()
     rng = np.random.default_rng(0)
@@ -107,6 +193,16 @@ if __name__ == "__main__":
     ap.add_argument("--rows", type=int, default=8, help="rows per request")
     ap.add_argument("--compare", action="store_true",
                     help="also measure the lock-serialized fallback")
+    ap.add_argument("--generate", action="store_true",
+                    help="drive POST /generate (decode scheduler) and "
+                         "print per-request timing waterfalls")
+    ap.add_argument("--trace-out", default=None,
+                    help="with --generate: dump the flight recorder as "
+                         "Chrome trace-event JSON (Perfetto-loadable)")
     a = ap.parse_args()
-    main(n_threads=a.threads, reqs_each=a.requests, rows=a.rows,
-         compare=a.compare)
+    if a.generate:
+        main_generate(n_threads=a.threads, reqs_each=a.requests,
+                      trace_out=a.trace_out)
+    else:
+        main(n_threads=a.threads, reqs_each=a.requests, rows=a.rows,
+             compare=a.compare)

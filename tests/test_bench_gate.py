@@ -69,3 +69,19 @@ def test_prefix_reuse_ttft_regression_is_caught():
 def test_prefix_reuse_healthy_row_passes():
     rows = {"prefix_reuse": {"ttft_steps_ratio": 0.25, "hit_tokens": 240}}
     assert bench.check_floors(rows) == []
+
+
+def test_trace_overhead_regression_is_caught():
+    """ISSUE 5 acceptance floor: the flight recorder must stay on in
+    production, so tracing-on throughput sliding below 95% of tracing-off
+    (someone adds an allocation, a lock, or a host sync to the append
+    path) must trip the gate — as must the field going missing."""
+    regs = bench.check_floors({"trace_overhead": {"throughput_ratio": 0.9}})
+    assert any("throughput_ratio=0.9 < floor" in r for r in regs), regs
+    regs = bench.check_floors({"trace_overhead": {"tokens_per_sec": 100.0}})
+    assert any("missing/non-numeric" in r for r in regs), regs
+
+
+def test_trace_overhead_healthy_row_passes():
+    rows = {"trace_overhead": {"throughput_ratio": 0.995}}
+    assert bench.check_floors(rows) == []

@@ -1,0 +1,469 @@
+"""Request-lifecycle tracing: the span flight recorder (ISSUE 5).
+
+The acceptance contract: ring appends survive concurrent writers and
+wraparound without locks or corruption; every request served by the
+decode scheduler leaves a span tree (queued -> prefix_restore -> prefill
+-> decode -> finish/cancel) whose Chrome trace-event export is
+Perfetto-valid — every ``B`` matched by an ``E``, monotonic ``ts``,
+per-slot and per-request tracks — including requests cancelled
+mid-prefill; `/generate` responses carry an `X-Request-Id` header and a
+``timings`` breakdown whose phases sum to the end-to-end latency; error
+responses (503/413/504) quote the request id; and the Prometheus text
+exposition now carries the saturation fields the JSON snapshot has.
+"""
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.inference import (DecodeScheduler, FlightRecorder,
+                                          MetricsRegistry)
+from deeplearning4j_tpu.inference.trace import new_request_id
+from deeplearning4j_tpu.models.sampling import generate_transformer
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.serving import InferenceServer
+
+
+def _lm(v=13, cache=96):
+    conf = transformer_lm(vocab_size=v, d_model=16, n_heads=2, n_blocks=2,
+                          rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = cache
+    return ComputationGraph(conf).init()
+
+
+def _validate_chrome(trace):
+    """Schema checks a Perfetto load would enforce: every B closed by an
+    E of the same name on the same (pid, tid), LIFO-nested, with
+    monotonic timestamps; instants carry a scope."""
+    stacks = {}
+    last_ts = {}
+    n_pairs = 0
+    for e in trace["traceEvents"]:
+        ph = e["ph"]
+        if ph == "M":
+            continue
+        key = (e["pid"], e["tid"])
+        assert e["ts"] >= last_ts.get(key, 0.0), (e, last_ts)
+        last_ts[key] = e["ts"]
+        if ph == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        elif ph == "E":
+            assert stacks.get(key), f"E without open B: {e}"
+            assert stacks[key][-1] == e["name"], (e, stacks[key])
+            stacks[key].pop()
+            n_pairs += 1
+        elif ph == "i":
+            assert e.get("s") == "t"
+        else:
+            raise AssertionError(f"unexpected phase {ph!r}: {e}")
+    assert all(not s for s in stacks.values()), f"unclosed spans: {stacks}"
+    return n_pairs
+
+
+# --------------------------------------------------------- ring mechanics --
+def test_ring_wraparound_under_concurrent_writers():
+    """8 threads x 500 appends into a 256-slot ring: every surviving
+    record is whole (no torn tuples), sequence numbers are unique and
+    the drop accounting matches — without any lock on the append path."""
+    rec = FlightRecorder(256)
+    n_threads, n_each = 8, 500
+
+    def writer(t):
+        for i in range(n_each):
+            rec.instant("w", slot=t, args={"i": i})
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = rec.snapshot()
+    evs = snap["events"]
+    assert len(evs) == 256  # the ring is exactly full, never over
+    seqs = [e["seq"] for e in evs]
+    assert len(set(seqs)) == len(seqs)
+    # export order is TIMESTAMP order (the guarantee the chrome export
+    # builds on; seq claims may race the stamp across writers)
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    assert snap["total_recorded"] == n_threads * n_each
+    assert snap["dropped"] == n_threads * n_each - 256
+    for e in evs:  # whole records only
+        assert e["ph"] == "i" and e["name"] == "w" and "i" in e["args"]
+
+
+def test_disabled_recorder_records_nothing():
+    rec = FlightRecorder(0)
+    rec.begin("x")
+    rec.instant("y")
+    rec.end("x")
+    assert not rec.enabled
+    assert rec.snapshot()["events"] == []
+    assert rec.chrome_trace()["traceEvents"] == []
+    rec2 = FlightRecorder(64, enabled=False)
+    rec2.instant("y")
+    assert rec2.snapshot()["events"] == []
+
+
+def test_chrome_export_repairs_wraparound_orphans():
+    """A ring that wrapped mid-span orphans one side of a B/E pair: the
+    export must drop the E whose B was overwritten and close the B whose
+    E never came, so the emitted stream is still schema-valid."""
+    rec = FlightRecorder(4)
+    rec.begin("lost")     # will be overwritten -> its E becomes orphan
+    rec.instant("a")
+    rec.instant("b")
+    rec.instant("c")
+    rec.instant("d")      # ring full: "lost" B is gone
+    rec.end("lost")
+    rec.begin("open")     # E never recorded
+    trace = rec.chrome_trace()
+    names = [(e["ph"], e["name"]) for e in trace["traceEvents"]
+             if e["ph"] != "M"]
+    assert ("E", "lost") not in names
+    assert ("B", "open") in names and ("E", "open") in names
+    _validate_chrome(trace)
+
+
+def test_limit_keeps_newest_events():
+    rec = FlightRecorder(128)
+    for i in range(50):
+        rec.instant("e", args={"i": i})
+    evs = rec.events(limit=10)
+    assert len(evs) == 10 and evs[-1]["args"]["i"] == 49
+
+
+def test_request_ids_are_unique():
+    ids = {new_request_id() for _ in range(100)}
+    assert len(ids) == 100
+
+
+# ------------------------------------------------- scheduler span trees --
+def test_engine_span_tree_and_timings_sum():
+    """One request's full span tree lands in the ring, Chrome export
+    validates, and the timings() phases sum to the end-to-end latency
+    (the per-request waterfall /generate echoes)."""
+    V = 13
+    net = _lm(V)
+    rec = FlightRecorder(4096)
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          metrics=MetricsRegistry(), tracer=rec).start()
+    try:
+        prompt = list(np.random.default_rng(0).integers(0, V, 37))
+        h = eng.submit(prompt, 5)
+        tokens = h.result(120)
+    finally:
+        eng.stop()
+    assert len(tokens) == 5
+    rid = h.request_id
+    track = f"request {rid}"
+    names = [(e["ph"], e["name"]) for e in rec.events()
+             if e["track"] == track]
+    for pair in (("B", "queued"), ("E", "queued"), ("B", "prefix_restore"),
+                 ("E", "prefix_restore"), ("B", "prefill"), ("E", "prefill"),
+                 ("B", "decode"), ("E", "decode"), ("i", "finish")):
+        assert pair in names, (pair, names)
+    # slot track: per-chunk prefill spans (37 tokens / 16 = 3 chunks),
+    # admit/free occupancy instants, and compile instants on the
+    # scheduler track (first-call compiles of each program family)
+    all_evs = rec.events()
+    chunks = [e for e in all_evs if e["name"] == "prefill_chunk"
+              and e["ph"] == "B" and e["args"]["request"] == rid]
+    assert len(chunks) == 3
+    assert {e["args"]["bucket"] for e in chunks} == {16}
+    assert any(e["name"] == "admit" for e in all_evs)
+    assert any(e["name"] == "free" for e in all_evs)
+    assert any(e["name"] == "compile" for e in all_evs)
+    _validate_chrome(rec.chrome_trace())
+    # the finish instant carries the summary request_summaries scrapes
+    summaries = rec.request_summaries()
+    assert summaries and summaries[-1]["request_id"] == rid
+    t = h.timings()
+    phases = t["queue_ms"] + t["restore_ms"] + t["prefill_ms"] \
+        + t["decode_ms"]
+    assert phases == pytest.approx(t["total_ms"], abs=0.05)
+    assert t["total_ms"] == pytest.approx(
+        (h.t_done - h.t_submit) * 1e3, abs=0.05)
+
+
+def test_cancelled_mid_prefill_span_tree_is_closed():
+    """A request cancelled while its prompt is still prefilling must
+    leave a VALID tree: its open `prefill` span closed, a `cancel`
+    instant with timings, its slot freed — and the Chrome export must
+    still pair every B/E."""
+    V = 13
+    net = _lm(V, cache=600)
+    rec = FlightRecorder(8192)
+    eng = DecodeScheduler(net, V, n_slots=1, prefill_chunk=16,
+                          metrics=MetricsRegistry(), tracer=rec).start()
+    try:
+        prompt = list(np.random.default_rng(1).integers(0, V, 512))
+        h = eng.submit(prompt, 4)
+        # wait until the scheduler is demonstrably mid-prefill
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(e["name"] == "prefill_chunk" for e in rec.events()):
+                break
+            time.sleep(0.002)
+        h.cancel()
+        with pytest.raises(TimeoutError):
+            h.result(0)
+        deadline = time.monotonic() + 60
+        while not h.done() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert h.done() and not h.tokens
+    finally:
+        eng.stop()
+    evs = rec.events()
+    track = f"request {h.request_id}"
+    names = [(e["ph"], e["name"]) for e in evs if e["track"] == track]
+    assert ("B", "prefill") in names
+    assert ("E", "prefill") in names  # closed by the cancel sweep
+    assert ("i", "cancel") in names
+    assert ("B", "decode") not in names  # never reached a first token
+    cancel = [e for e in evs if e["name"] == "cancel"
+              and e["track"] == track][0]
+    assert cancel["args"]["tokens"] == 0
+    assert cancel["args"]["total_ms"] > 0
+    assert any(e["name"] == "free" for e in evs)
+    _validate_chrome(rec.chrome_trace())
+
+
+# ------------------------------------------------------------ HTTP layer --
+def test_generate_response_carries_request_id_and_timings():
+    V = 13
+    net = _lm(V)
+    prompt = np.random.default_rng(2).integers(0, V, 20).tolist()
+    solo = generate_transformer(net, prompt, 4, V, use_cache=True)
+    srv = InferenceServer(net=net, decode_vocab=V, decode_slots=2,
+                          prefill_chunk=16).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = json.dumps({"prompt": prompt, "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req)
+        out = json.loads(resp.read())
+        assert out["tokens"] == solo
+        rid = resp.headers["X-Request-Id"]
+        assert rid and out["request_id"] == rid
+        t = out["timings"]
+        phases = t["queue_ms"] + t["restore_ms"] + t["prefill_ms"] \
+            + t["decode_ms"]
+        # the acceptance bound: phases sum to within 5% of the measured
+        # end-to-end latency (they are contiguous segments of it)
+        assert phases == pytest.approx(t["total_ms"], rel=0.05, abs=0.2)
+        # a client-supplied id survives as the prefix of a
+        # server-uniquified id (a retry reusing the id must not merge
+        # two live requests onto one trace track)
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "client-abc"})
+        resp = urllib.request.urlopen(req)
+        crid = resp.headers["X-Request-Id"]
+        assert re.fullmatch(r"client-abc\.r\d+", crid), crid
+        assert json.loads(resp.read())["request_id"] == crid
+        # /trace knows the request: its spans are queryable by id
+        snap = json.loads(urllib.request.urlopen(base + "/trace").read())
+        tracks = {e["track"] for e in snap["events"]}
+        assert f"request {rid}" in tracks and f"request {crid}" in tracks
+        chrome = json.loads(urllib.request.urlopen(
+            base + "/trace?format=chrome").read())
+        _validate_chrome(chrome)
+        thread_names = [e["args"]["name"] for e in chrome["traceEvents"]
+                        if e["ph"] == "M" and e["name"] == "thread_name"]
+        assert any(n.startswith("slot ") for n in thread_names)
+        assert any(n.startswith("request ") for n in thread_names)
+        # ?limit trims to the newest N records
+        limited = json.loads(urllib.request.urlopen(
+            base + "/trace?limit=5").read())
+        assert len(limited["events"]) == 5
+    finally:
+        srv.stop()
+
+
+def test_error_bodies_quote_the_request_id():
+    """413 (prompt too long) and 503 (decode queue full) responses must
+    carry the id a client can quote — and the flight recorder must hold
+    a matching reject instant."""
+    V = 13
+    net = _lm(V, cache=24)
+    srv = InferenceServer(net=net, decode_vocab=V, decode_slots=1,
+                          prefill_chunk=16).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = json.dumps({"prompt": list(range(5)) * 10,
+                           "max_new_tokens": 8}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 413
+        err = json.loads(e.value.read())
+        rid = err["request_id"]
+        assert rid and e.value.headers["X-Request-Id"] == rid
+        rejects = [ev for ev in srv.tracer.events()
+                   if ev["name"] == "reject"]
+        assert any(ev["args"].get("request_id") == rid
+                   and ev["args"]["reason"] == "prompt_too_long"
+                   for ev in rejects)
+    finally:
+        srv.stop()
+
+
+def test_malformed_client_request_id_is_replaced_not_echoed():
+    """An obs-folded X-Request-Id reaches the handler with embedded
+    CR/LF; echoing it verbatim would be response-header injection. The
+    server must substitute a generated id."""
+    import socket
+    V = 13
+    net = _lm(V)
+    srv = InferenceServer(net=net, decode_vocab=V,
+                          prefill_chunk=16).start()
+    try:
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_new_tokens": 2}).encode()
+        raw = (b"POST /generate HTTP/1.1\r\n"
+               b"Host: 127.0.0.1\r\n"
+               b"Content-Type: application/json\r\n"
+               b"X-Request-Id: abc\r\n\tSet-Cookie: evil=1\r\n"
+               b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+               b"Connection: close\r\n\r\n" + body)
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=120) as s:
+            s.sendall(raw)
+            s.settimeout(120)
+            resp = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                resp += chunk
+        head, _, payload = resp.partition(b"\r\n\r\n")
+        assert b"Set-Cookie" not in head  # nothing injected
+        out = json.loads(payload)
+        assert re.fullmatch(r"r\d+", out["request_id"])  # server-generated
+        hdr = [ln for ln in head.split(b"\r\n")
+               if ln.lower().startswith(b"x-request-id:")]
+        assert hdr == [b"X-Request-Id: " + out["request_id"].encode()]
+    finally:
+        srv.stop()
+
+
+def test_trace_buffer_zero_disables_the_recorder():
+    V = 13
+    net = _lm(V)
+    srv = InferenceServer(net=net, decode_vocab=V, trace_buffer=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_new_tokens": 2}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert len(out["tokens"]) == 2  # serving works untraced
+        assert "timings" in out  # timings come from the handle, not the ring
+        snap = json.loads(urllib.request.urlopen(base + "/trace").read())
+        assert snap["events"] == [] and snap["capacity"] == 0
+    finally:
+        srv.stop()
+
+
+def test_trace_dump_cli_writes_perfetto_loadable_json(tmp_path):
+    """`python -m deeplearning4j_tpu.inference.trace dump` against a live
+    server writes a file whose content passes the same schema check."""
+    from deeplearning4j_tpu.inference import trace as trace_mod
+    V = 13
+    net = _lm(V)
+    srv = InferenceServer(net=net, decode_vocab=V,
+                          prefill_chunk=16).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = json.dumps({"prompt": list(range(10)),
+                           "max_new_tokens": 3}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"}))
+        out = tmp_path / "trace.json"
+        rc = trace_mod.main(["dump", "--url", base, "--out", str(out)])
+        assert rc == 0
+        trace = json.loads(out.read_text())
+        assert _validate_chrome(trace) > 0
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------- satellites: metrics/UI --
+def test_text_exposition_has_saturation_fields():
+    """render_text parity with the JSON snapshot: gauge high-water marks,
+    histogram extremes, and uptime are scrapeable."""
+    m = MetricsRegistry()
+    g = m.gauge("depth")
+    g.set(9)
+    g.set(2)
+    h = m.histogram("lat")
+    h.record(0.004)
+    h.record(0.2)
+    text = m.render_text()
+    assert "depth 2" in text
+    assert "depth_max 9" in text
+    assert "lat_min 0.004" in text
+    assert "lat_max 0.2" in text
+    assert "uptime_sec " in text
+    # empty histograms expose count only (no NaN min/max lines)
+    m.histogram("empty")
+    text = m.render_text()
+    assert "empty_count 0" in text and "empty_min" not in text
+
+
+def test_serving_page_renders_trace_waterfall():
+    from deeplearning4j_tpu.ui.listeners import post_serving_metrics
+    from deeplearning4j_tpu.ui.server import UiServer
+    V = 13
+    net = _lm(V)
+    rec = FlightRecorder(2048)
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          metrics=m, tracer=rec).start()
+    try:
+        eng.submit(list(range(10)), 3).result(120)
+    finally:
+        eng.stop()
+    ui = UiServer(port=0)
+    try:
+        url = f"http://127.0.0.1:{ui.port}"
+        post_serving_metrics(url, m, session_id="s1", tracer=rec)
+        page = urllib.request.urlopen(url + "/serving").read().decode()
+        assert "recent requests" in page  # the waterfall section
+        data = json.loads(urllib.request.urlopen(
+            url + "/serving/data?sid=s1").read())
+        assert data["trace"], data
+        row = data["trace"][-1]
+        assert row["outcome"] == "finish" and row["tokens"] == 3
+        assert {"queue_ms", "restore_ms", "prefill_ms", "decode_ms",
+                "total_ms"} <= set(row)
+    finally:
+        ui.stop()
+
+
+def test_serve_cli_trace_buffer_flag_parses():
+    from deeplearning4j_tpu.cli.main import build_parser
+    args = build_parser().parse_args(
+        ["serve", "--model", "m.zip", "--trace-buffer", "1024"])
+    assert args.trace_buffer == 1024
+    args = build_parser().parse_args(["serve", "--model", "m.zip"])
+    assert args.trace_buffer == 8192
