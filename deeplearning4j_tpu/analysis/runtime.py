@@ -132,15 +132,28 @@ class CompileCounter:
 
     @classmethod
     def for_scheduler(cls, scheduler) -> "CompileCounter":
-        """Budgets for a DecodeScheduler: 1 decode program, <=1 prefill
-        program per pow2 chunk bucket (0 when chunking is off), 1
-        slot-reset program, and — when the prefix KV pool is enabled —
-        <=1 restore and <=1 publish program per pow2 block-chain bucket
-        (kvpool.gather_blocks / scatter_blocks)."""
+        """Budgets for a DecodeScheduler.
+
+        Contiguous mode: 1 decode program, <=1 prefill program per pow2
+        chunk bucket (0 when chunking is off), 1 slot-reset program, and
+        — when the prefix KV pool is enabled — <=1 restore and <=1
+        publish program per pow2 block-chain bucket
+        (kvpool.gather_blocks / scatter_blocks).
+
+        Paged mode (engine.paged): block tables are padded to pow2
+        bucket widths like every other shape, so decode is <=1 program
+        per TABLE bucket, prefill <=1 per (chunk bucket, table bucket)
+        pair, plus one pos-set and one COW block-copy program — a FIXED
+        family regardless of sequence lengths, slot churn, or pool
+        pressure (no per-length recompiles)."""
         c = cls()
-        c.track("decode", scheduler._jstep, budget=1)
+        tb = len(getattr(scheduler, "table_buckets", []) or [])
+        paged = bool(getattr(scheduler, "paged", False))
+        c.track("decode", scheduler._jstep,
+                budget=max(1, tb) if paged else 1)
+        pf = len(scheduler.prefill_buckets)
         c.track("prefill", scheduler._jprefill,
-                budget=len(scheduler.prefill_buckets))
+                budget=pf * max(1, tb) if paged else pf)
         jzero = getattr(scheduler, "_jzero", None)
         if jzero is not None:
             c.track("admit_reset", jzero, budget=1)
@@ -152,6 +165,12 @@ class CompileCounter:
         if jpublish is not None:
             c.track("prefix_publish", jpublish,
                     budget=len(scheduler.restore_buckets))
+        jsetpos = getattr(scheduler, "_jsetpos", None)
+        if jsetpos is not None:
+            c.track("restore_setpos", jsetpos, budget=1)
+        jcow = getattr(scheduler, "_jcow", None)
+        if jcow is not None:
+            c.track("block_cow", jcow, budget=1)
         return c
 
 

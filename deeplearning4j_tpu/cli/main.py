@@ -14,8 +14,8 @@ Usage:
                    [--batch-window-ms MS] [--queue-size N] [--timeout-ms MS]
                    [--trace-buffer N]
                    [--generate [--vocab-size V] [--decode-slots N]
-                    [--prefill-chunk C] [--prefix-cache-mb MB]
-                    [--kv-block B]]
+                    [--prefill-chunk C] [--kv-pool-mb MB]
+                    [--prefix-cache-mb MB] [--kv-block B]]
 """
 from __future__ import annotations
 
@@ -108,6 +108,7 @@ def cmd_serve(args) -> int:
               prefill_chunk=args.prefill_chunk,
               prefix_cache_mb=args.prefix_cache_mb,
               kv_block=args.kv_block,
+              kv_pool_mb=args.kv_pool_mb,
               trace_buffer=args.trace_buffer)
     if getattr(args, "int8", False):
         # artifact must carry calibration (nn/quantization.save_quantized);
@@ -146,13 +147,20 @@ def cmd_serve(args) -> int:
     # report the pool's ACTUAL state, not the flag: the scheduler
     # disables it (with a RuntimeWarning) when the model has no KV cache
     # or the budget cannot fit two blocks
-    pool_on = getattr(getattr(server, "_decoder", None), "pool",
-                      None) is not None
+    decoder = getattr(server, "_decoder", None)
+    pool_on = getattr(decoder, "pool", None) is not None
+    paged_on = bool(getattr(decoder, "paged", False))
+    if paged_on:
+        kv_mode = (f", paged KV pool {args.kv_pool_mb}MB "
+                   f"({decoder.pool.capacity_blocks} blocks of "
+                   f"{args.kv_block})")
+    elif pool_on:
+        kv_mode = (f", prefix cache {args.prefix_cache_mb}MB "
+                   f"(block {args.kv_block})")
+    else:
+        kv_mode = ", prefix cache OFF"
     gen_mode = (f"; /generate: {args.decode_slots} slots, "
-                f"prefill chunk {args.prefill_chunk}"
-                + (f", prefix cache {args.prefix_cache_mb}MB "
-                   f"(block {args.kv_block})" if pool_on
-                   else ", prefix cache OFF")
+                f"prefill chunk {args.prefill_chunk}" + kv_mode
                 if args.generate else "")
     print(f"Serving {args.model} ({mode}, {batch_mode}{gen_mode}) on "
           f"http://127.0.0.1:{server.port} "
@@ -246,9 +254,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "completed prompts' K/V blocks are pooled and "
                         "repeated prefixes restored instead of "
                         "re-prefilled (0 = disabled)")
+    s.add_argument("--kv-pool-mb", type=float, default=0.0,
+                   help="byte budget (MiB) for the PAGED live-decode KV "
+                        "pool: all slots share one block pool (capacity "
+                        "is pool bytes, not slots x max_cache_len), "
+                        "prefix restore is a zero-copy block-table "
+                        "remap, and cold slots preempt-and-resume under "
+                        "pressure; supersedes --prefix-cache-mb "
+                        "(0 = contiguous per-slot caches)")
     s.add_argument("--kv-block", type=int, default=16,
-                   help="positions per prefix-cache block (only full "
-                        "blocks of a prompt are shared)")
+                   help="positions per KV block, paged pool and prefix "
+                        "cache alike (only full blocks of a prompt are "
+                        "shared)")
     s.add_argument("--trace-buffer", type=int, default=8192,
                    help="span flight-recorder ring capacity (events) "
                         "backing GET /trace and per-request timings; "

@@ -4,16 +4,19 @@ generative decode scheduling, prefix KV reuse, and SLO metrics.
 The pieces compose into the serving stack (`serving/server.py`):
 `MicroBatcher` aggregates concurrent `/predict` requests into bucketed
 padded batches; `DecodeScheduler` continuously batches generative decode
-over the attention KV cache, reusing cached prompt prefixes through the
-block-pooled `KVPool` prefix index; `MetricsRegistry` records queue
-depth, batch occupancy, hit rates, and latency percentiles, exported at
+over the attention KV cache — paged (`kv_pool_mb`: all slots share one
+`KVPool` block pool through per-slot block tables, with zero-copy prefix
+restore/publish and preempt-and-swap under pool pressure) or contiguous
+per-slot stripes with a `KVPool` side prefix cache; `MetricsRegistry`
+records queue depth, batch occupancy, hit rates, pool occupancy, and
+latency percentiles, exported at
 `GET /metrics`; the `FlightRecorder` span flight recorder (`trace.py`)
 records every request's lifecycle — queued/restore/prefill/decode span
 trees plus scheduler instants — exported at `GET /trace` (JSON or
 Perfetto-loadable Chrome trace-event format).
 """
 from .batcher import (InferenceFuture, MicroBatcher, QueueFullError,
-                      RequestTimeoutError, pow2_buckets)
+                      RequestTimeoutError, bucket_for, pow2_buckets)
 from .engine import DecodeHandle, DecodeScheduler, PromptTooLongError
 from .kvpool import KVPool
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
@@ -23,5 +26,6 @@ from .trace import FlightRecorder, default_recorder, new_request_id
 __all__ = ["Counter", "DecodeHandle", "DecodeScheduler", "FlightRecorder",
            "Gauge", "Histogram", "InferenceFuture", "KVPool",
            "MetricsRegistry", "MicroBatcher", "PromptTooLongError",
-           "QueueFullError", "RequestTimeoutError", "default_recorder",
-           "default_registry", "new_request_id", "pow2_buckets"]
+           "QueueFullError", "RequestTimeoutError", "bucket_for",
+           "default_recorder", "default_registry", "new_request_id",
+           "pow2_buckets"]

@@ -95,6 +95,15 @@ def pow2_buckets(max_batch: int) -> List[int]:
     return out
 
 
+def bucket_for(n: int, buckets: List[int]) -> int:
+    """Smallest bucket covering ``n`` (buckets ascending — the output of
+    `pow2_buckets`). One definition shared by the collator's batch
+    padding, the scheduler's prefill-chunk sizing, and the paged-KV
+    block-table widths, so every padded shape follows the same
+    compile-once-per-bucket discipline."""
+    return next(b for b in buckets if b >= n)
+
+
 _pow2_buckets = pow2_buckets  # back-compat alias
 
 
@@ -288,7 +297,7 @@ class MicroBatcher:
         pieces = []
         for off in range(0, n, self.max_batch):
             chunk = cat[off:off + self.max_batch]
-            bucket = next(b for b in self.buckets if b >= chunk.shape[0])
+            bucket = bucket_for(chunk.shape[0], self.buckets)
             if bucket > chunk.shape[0]:
                 pad = np.zeros((bucket - chunk.shape[0],) + chunk.shape[1:],
                                chunk.dtype)

@@ -56,11 +56,28 @@ finishes, its prompt's full blocks are published back into the pool
 indexed; cached keys are stored pre-rotated at absolute positions, so a
 pos-0-anchored prefix is bit-identical across requests.
 
+Paged KV decode (the ISSUE 6 tentpole, ``kv_pool_mb > 0``): the live
+decode cache itself becomes the block pool. Per-layer K/V moves from
+``[n_slots, max_cache_len]`` stripes into pool-wide page arrays
+(``[capacity+1, kv_block]`` rows, page 0 scratch) and each slot reaches
+its rows through a host-authoritative int32 block table shipped per
+dispatch, padded to pow2 bucket widths (one XLA program per bucket — no
+per-length recompiles). HBM cost stops being ``slots × max_cache_len``:
+admission is bounded by POOL bytes (oversize prompts 413 only when they
+cannot fit the whole pool), blocks allocate lazily as ``pos`` crosses
+block boundaries, prefix restore/publish degenerate to zero-copy
+block-table remaps against the pool's trie (copy-on-write duplicates
+the one shared block a full-prompt hit's refeed writes), and under pool
+pressure the latest-submitted slot is preempted — blocks released,
+sequence requeued at the front, resumed later by re-prefilling prompt +
+generated-so-far (host RNG untouched, so the resumed output is
+token-identical to an unpreempted run).
+
 Token selection reuses `models/sampling.sample_logits`, so greedy engine
 output is token-identical to solo `generate_transformer(use_cache=True)`
-decoding (tested, chunked and token-by-token, prefix-restored and cold),
-and seeded sampled output matches too (same per-sequence RNG consumption
-order).
+decoding (tested, chunked and token-by-token, prefix-restored and cold,
+paged and contiguous), and seeded sampled output matches too (same
+per-sequence RNG consumption order).
 
 Works for both facades: transformer ComputationGraphs (KV-cache states)
 and recurrent MultiLayerNetworks (h/c states — admitting a sequence zeroes
@@ -84,7 +101,7 @@ from ..models.sampling import sample_logits
 from ..nn.layers.recurrent import (BaseRecurrentImpl,
                                    _materialize_rnn_states)
 from ..nn.multilayer import _compute_dtype_of
-from .batcher import QueueFullError, pow2_buckets
+from .batcher import QueueFullError, bucket_for, pow2_buckets
 from .kvpool import SCRATCH_BLOCK, KVPool, gather_blocks, scatter_blocks
 from .metrics import MetricsRegistry, default_registry
 from .trace import FlightRecorder, default_recorder, new_request_id
@@ -96,11 +113,18 @@ _MIN_CHUNK_BUCKET = 16
 
 
 class PromptTooLongError(ValueError):
-    """The request cannot fit the KV cache: ``len(prompt) +
-    max_new_tokens - 1 > max_cache_len``. Raised at submit time (never
-    admitted, never queued) so the serving layer can answer HTTP 413
-    instead of the sequence dying mid-decode on the attention layer's
+    """The request cannot fit the KV cache. Contiguous mode:
+    ``len(prompt) + max_new_tokens - 1 > max_cache_len``. Paged mode the
+    bound is the WHOLE pool — rejected only when the request's block
+    count exceeds ``capacity_blocks`` (``blocks_needed`` /
+    ``blocks_available`` attributes carry the admission math for the
+    serving layer's 413 body). Raised at submit time (never admitted,
+    never queued) so the serving layer can answer HTTP 413 instead of
+    the sequence dying mid-decode on the attention layer's
     cache-overflow guard."""
+
+    blocks_needed: Optional[int] = None
+    blocks_available: Optional[int] = None
 
 
 class DecodeHandle:
@@ -183,7 +207,9 @@ class DecodeHandle:
 class _ActiveSeq:
     """Book-keeping for one slot-resident sequence."""
     __slots__ = ("handle", "prompt", "fed", "rng", "temperature", "top_k",
-                 "top_p", "eos_id", "steps", "pool_node")
+                 "top_p", "eos_id", "steps", "pool_node", "block_ids",
+                 "shared", "written", "phase", "resumed", "folded",
+                 "cow_starved")
 
     def __init__(self, handle: DecodeHandle, prompt: Sequence[int],
                  temperature: float, top_k: Optional[int],
@@ -198,6 +224,23 @@ class _ActiveSeq:
         self.eos_id = eos_id
         self.steps = 0  # engine iterations that advanced this sequence
         self.pool_node = None  # locked trie node of the restored prefix
+        # -- paged-mode bookkeeping (engine.paged) --
+        self.block_ids: List[int] = []  # table entries, logical order
+        self.shared: List[bool] = []    # True = trie-owned (COW on write)
+        self.written = 0  # host mirror of the slot's device cache pos
+        # request-track span currently open ("queued" -> "prefill" ->
+        # "decode", with "preempted" bridging a swap-out) — the single
+        # source of truth for span transitions, because a RESUMED
+        # sequence re-enters prefill with t_first_token already stamped
+        self.phase = "queued"
+        self.resumed = False  # has been preempted at least once
+        self.folded = 0  # generated tokens already folded into `prompt`
+        # set when a COW duplicate could not get a page even by
+        # preempting (every page backs this very prompt): the resume's
+        # restore caps its hit one block short so no write ever lands in
+        # a shared block — without this a full-pool full-prompt hit
+        # would preempt/restore/starve forever
+        self.cow_starved = False
 
     def next_input(self) -> int:
         """Token to feed this step: the next prompt token while prefilling,
@@ -229,12 +272,25 @@ class DecodeScheduler:
     tail latency to resident decodes). <= 1 disables chunked prefill and
     restores token-by-token prompt feeding through the decode step.
 
-    ``prefix_cache_mb``: byte budget (MiB) for the prefix KV pool
-    (`inference/kvpool.py`); 0 disables prefix reuse. ``kv_block``:
-    positions per pool block — only full blocks of a prompt are shared,
-    so smaller blocks match more but cost more metadata. The pool only
-    engages for attention nets (pos-0-anchored KV prefixes; recurrent
-    h/c state has no position-addressed rows to share).
+    ``kv_pool_mb``: byte budget (MiB) for the PAGED live-decode KV pool
+    (`inference/kvpool.py`, the ISSUE 6 tentpole). > 0 replaces the
+    per-slot contiguous ``max_cache_len`` stripes with pool-wide
+    fixed-size pages reached through per-slot block tables: slot
+    capacity is bounded by pool bytes (admission is pool-sized, not
+    ``max_cache_len``-sized), blocks allocate lazily as ``pos`` crosses
+    block boundaries, prefix restore/publish are zero-copy block-table
+    remaps against the built-in trie prefix index, and under pool
+    pressure the latest-submitted slot is preempted (blocks released,
+    sequence requeued and later resumed, token-identically). Attention
+    nets only; recurrent nets fall back to contiguous with a warning.
+
+    ``prefix_cache_mb``: byte budget (MiB) for the CONTIGUOUS-mode side
+    prefix pool (ignored when ``kv_pool_mb`` is set — the paged pool is
+    its own prefix cache); 0 disables prefix reuse. ``kv_block``:
+    positions per pool block in either mode — only full blocks of a
+    prompt are shared, so smaller blocks match more but cost more
+    metadata. Pools only engage for attention nets (pos-0-anchored KV
+    prefixes; recurrent h/c state has no position-addressed rows).
 
     ``tracer``: span flight recorder (`inference/trace.py`, default the
     process-wide one). Every request's lifecycle is recorded — queued /
@@ -256,6 +312,7 @@ class DecodeScheduler:
     def __init__(self, net, vocab_size: int, *, n_slots: int = 4,
                  max_queue: int = 64, prefill_chunk: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
+                 kv_pool_mb: float = 0.0,
                  metrics: Optional[MetricsRegistry] = None,
                  tracer: Optional[FlightRecorder] = None,
                  transfer_guard: Optional[str] = None):
@@ -280,26 +337,19 @@ class DecodeScheduler:
         self._graph = hasattr(net.conf, "vertices")  # facade detection
         self._dtype = _compute_dtype_of(net.conf.conf)
         self._cache_cap = self._min_cache_len()
-        self._states = self._init_states()
+        # abstract shapes first (jax.eval_shape — no device allocation):
+        # paged mode replaces the contiguous [n_slots, max_cache_len]
+        # stripes with pool pages, and materializing stripes only to
+        # throw them away would make startup peak HBM stripes + pool —
+        # the exact cost the paged layout exists to eliminate
+        abstract_states = jax.eval_shape(self._init_states)
+        self._states = None  # materialized once the KV layout is known
         self._slots: List[Optional[_ActiveSeq]] = [None] * self.n_slots
         self._queue: List[_ActiveSeq] = []
         self._cond = threading.Condition()
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._transfer_guard = transfer_guard
-        self._jstep = jax.jit(self._step_fn)
-        # one prefill program per pow2 chunk bucket (the SAME jitted
-        # callable; each distinct ids length C is its own XLA program,
-        # compiled once and reused across requests — the batcher's
-        # compile-once-per-bucket discipline applied to prefill).
-        # n_real is data-dependent (real tokens in a padded chunk) and
-        # MUST stay traced: static it would recompile per tail length,
-        # defeating the bucket discipline.
-        self._jprefill = jax.jit(self._prefill_fn)  # graftlint: disable=JG004
-        # slot admission zeroes one slot's rows in ONE fused program
-        # (eagerly tree-mapped .at[].set(0) dispatched per leaf AND fed
-        # the slot index as an implicit scalar transfer per leaf)
-        self._jzero = jax.jit(self._zero_fn)
         if self.prefill_chunk > 1:
             lo = min(_MIN_CHUNK_BUCKET, self.prefill_chunk)
             self.prefill_buckets = [b for b in pow2_buckets(self.prefill_chunk)
@@ -316,21 +366,95 @@ class DecodeScheduler:
         self._chunk_dense = bool(stateful) and all(
             type(impl).__name__ == "SelfAttentionLayerImpl"
             for impl in stateful)
-        # prefix KV reuse (kvpool.py): attention nets only — cached
-        # prefixes are position-addressed K/V rows anchored at pos 0,
-        # which recurrent h/c state does not have
+        # KV memory layout (kvpool.py) — attention nets only: both modes
+        # manage position-addressed K/V rows, which recurrent h/c state
+        # does not have.
+        #   kv_pool_mb > 0  -> PAGED: the pool IS the live decode cache
+        #     (per-layer page arrays in self._states, per-slot block
+        #     tables, zero-copy prefix restore/publish, preempt-and-swap)
+        #   prefix_cache_mb -> contiguous slots + a side prefix pool
+        #     restored by jitted block-gather (the ISSUE 4 layout, kept
+        #     as the token-identity reference)
         self.kv_block = int(kv_block)
         self.pool: Optional[KVPool] = None
+        self.paged = False
         self.restore_buckets: List[int] = []
+        self.table_buckets: List[int] = []
         self._jrestore = None
         self._jpublish = None
-        if (prefix_cache_mb and prefix_cache_mb > 0 and self._chunk_dense
+        self._jsetpos = None
+        self._jcow = None
+        self._table: Optional[np.ndarray] = None
+        attn_keys = [key for key, st in abstract_states.items()
+                     if isinstance(st, dict) and "k" in st and "v" in st
+                     and "pos" in st]
+        if kv_pool_mb and kv_pool_mb > 0:
+            if self._chunk_dense and attn_keys and self.kv_block >= 1:
+                attn = {key: abstract_states[key] for key in attn_keys}
+                pool = KVPool(attn, block=self.kv_block, paged=True,
+                              budget_bytes=int(kv_pool_mb * (1 << 20)),
+                              metrics=self.metrics, tracer=self.tracer)
+                if pool.capacity_blocks > 0:
+                    self.pool = pool
+                    self.paged = True
+                    # the contiguous [n_slots, max_cache_len] stripes are
+                    # replaced by ONE pool-wide page array per layer
+                    # (page 0 = scratch); a slot's reach is its block
+                    # table, so capacity is pool bytes, not slots x cap
+                    pages = pool.capacity_blocks + 1
+                    # materialize straight into the paged layout: the
+                    # contiguous stripes are never allocated. Zeros match
+                    # init_state for every entry — paged requires
+                    # _chunk_dense, so all stateful layers are attention
+                    self._states = {
+                        key: jax.tree_util.tree_map(
+                            lambda s: jnp.zeros(s.shape, s.dtype), st)
+                        for key, st in abstract_states.items()
+                        if key not in attn_keys}
+                    for key in attn_keys:
+                        st = abstract_states[key]
+                        tail = st["k"].shape[2:]
+                        self._states[key] = {
+                            "k_pages": jnp.zeros(
+                                (pages, self.kv_block) + tail,
+                                st["k"].dtype),
+                            "v_pages": jnp.zeros(
+                                (pages, self.kv_block) + tail,
+                                st["v"].dtype),
+                            "pos": jnp.zeros(st["pos"].shape,
+                                             st["pos"].dtype),
+                        }
+                    self._cache_cap = pool.capacity_blocks * self.kv_block
+                    self.table_buckets = pow2_buckets(pool.capacity_blocks)
+                    self._table = np.full(
+                        (self.n_slots, pool.capacity_blocks),
+                        SCRATCH_BLOCK, np.int32)
+            if not self.paged:
+                warnings.warn(
+                    f"kv_pool_mb={kv_pool_mb} requested but paged KV "
+                    "decode is DISABLED (contiguous per-slot caches "
+                    "instead): "
+                    + ("the model has no attention KV cache to page"
+                       if not self._chunk_dense or not attn_keys
+                       else "the byte budget is smaller than two "
+                            f"{self.kv_block}-position blocks"),
+                    RuntimeWarning, stacklevel=2)
+            elif prefix_cache_mb and prefix_cache_mb > 0:
+                warnings.warn(
+                    "prefix_cache_mb is ignored when kv_pool_mb is set: "
+                    "the paged pool IS the prefix cache (finished "
+                    "prompts' blocks are adopted by the trie in place, "
+                    "zero-copy)", RuntimeWarning, stacklevel=2)
+        # NOT elif: when kv_pool_mb was requested but paged could not
+        # engage, a configured prefix_cache_mb must still buy the
+        # contiguous side pool — silently dropping BOTH knobs would
+        # leave the operator with no prefix cache and no warning
+        if (not self.paged and prefix_cache_mb and prefix_cache_mb > 0
+                and self._chunk_dense
                 and self._cache_cap is not None
                 and self.kv_block >= 1
                 and self._cache_cap >= self.kv_block):
-            attn = {key: st for key, st in self._states.items()
-                    if isinstance(st, dict) and "k" in st and "v" in st
-                    and "pos" in st}
+            attn = {key: abstract_states[key] for key in attn_keys}
             pool = KVPool(attn, block=self.kv_block,
                           budget_bytes=int(prefix_cache_mb * (1 << 20)),
                           metrics=self.metrics, tracer=self.tracer)
@@ -351,7 +475,9 @@ class DecodeScheduler:
                 self._jpublish = jax.jit(functools.partial(
                     scatter_blocks, block=self.kv_block),
                     donate_argnums=(4,))
-        if prefix_cache_mb and prefix_cache_mb > 0 and self.pool is None:
+        if (not self.paged
+                and prefix_cache_mb and prefix_cache_mb > 0
+                and self.pool is None):
             # the knob was set but the pool could not engage — without
             # this the operator sees a phantom cache (banner/flags say
             # on, every prompt still pays full prefill, no prefix_*
@@ -367,6 +493,34 @@ class DecodeScheduler:
                    else "the byte budget is smaller than two "
                         f"{self.kv_block}-position blocks"),
                 RuntimeWarning, stacklevel=2)
+        if self._states is None:
+            # contiguous layouts (and the LSTM fallback) materialize the
+            # per-slot stripes the abstract pass only described
+            self._states = self._init_states()
+        self._jstep = jax.jit(
+            self._step_paged_fn if self.paged else self._step_fn)
+        # one prefill program per pow2 chunk bucket (the SAME jitted
+        # callable; each distinct ids length C is its own XLA program,
+        # compiled once and reused across requests — the batcher's
+        # compile-once-per-bucket discipline applied to prefill). Paged
+        # mode multiplies in the block-table width buckets: one program
+        # per (chunk bucket, table bucket) pair, still a FIXED family.
+        # n_real is data-dependent (real tokens in a padded chunk) and
+        # MUST stay traced: static it would recompile per tail length,
+        # defeating the bucket discipline.
+        self._jprefill = jax.jit(
+            self._prefill_paged_fn if self.paged
+            else self._prefill_fn)  # graftlint: disable=JG004
+        # slot admission zeroes one slot's rows in ONE fused program
+        # (eagerly tree-mapped .at[].set(0) dispatched per leaf AND fed
+        # the slot index as an implicit scalar transfer per leaf)
+        self._jzero = jax.jit(self._zero_fn)
+        if self.paged:
+            # restore remaps the table host-side; the only device work is
+            # setting the slot's pos past the hit (one tiny program) and
+            # the occasional copy-on-write block duplication (one more)
+            self._jsetpos = jax.jit(self._setpos_fn)
+            self._jcow = jax.jit(self._cow_fn)
         self._prefill_next = 0  # round-robin over prefilling slots
         self._emitted_this_iter = 0  # scheduler-thread-only tally
         m = self.metrics
@@ -386,6 +540,8 @@ class DecodeScheduler:
         self._m_prefill_chunk = m.histogram(
             "prefill_chunk_size", lo=1.0,
             hi=float(max(self.prefill_buckets or [1])) + 1, per_decade=12)
+        if self.paged:
+            self._m_preempted = m.counter("decode_preempted_total")
         if self.pool is not None:
             self._m_prefix_lookups = m.counter("prefix_cache_lookups_total")
             self._m_prefix_hits = m.counter("prefix_cache_hits_total")
@@ -455,7 +611,11 @@ class DecodeScheduler:
         for key, st in new_states.items():
             old = old_states[key]
             if isinstance(st, dict):
-                out[key] = {k: (v if k in ("k", "v") else sel(v, old[k]))
+                # pages are exempt like k/v: a masked slot's paged write
+                # was redirected to the scratch page in-program (wmask),
+                # so there is nothing to roll back
+                out[key] = {k: (v if k in ("k", "v", "k_pages", "v_pages")
+                                else sel(v, old[k]))
                             for k, v in st.items()}
             else:
                 out[key] = sel(st, old)
@@ -471,25 +631,74 @@ class DecodeScheduler:
         out, new_states = self._forward(params, variables, x, states)
         return out[:, -1, :], self._freeze_states(new_states, states, live)
 
+    def _inject_paged(self, states, table, wmask):
+        """Hand the per-call block table (and write mask) to every paged
+        attention state entry. The table is HOST-authoritative (the
+        scheduler mutates it between steps) and shipped per dispatch —
+        never part of the carried device state — so allocation, restore
+        remaps, COW swaps, and preemption are plain numpy writes with no
+        device program of their own."""
+        out = {}
+        for key, st in states.items():
+            if isinstance(st, dict) and "k_pages" in st:
+                out[key] = {**st, "table": table, "wmask": wmask}
+            else:
+                out[key] = st
+        return out
+
+    def _step_paged_fn(self, params, variables, ids, live, table, states):
+        """Paged-mode decode step: `_step_fn` plus the block ``table``
+        ([n_slots, nb], nb a pow2 bucket covering the deepest live slot).
+        ``live`` doubles as the write mask — a masked (idle or
+        mid-prefill) slot's K/V write is redirected to the scratch page
+        inside the attention layer, so it can never corrupt a shared
+        block at its own frontier (the contiguous-mode argument "the
+        garbage row is overwritten by the slot's next real write" does
+        not survive sharing). One XLA program per table bucket."""
+        x = jax.nn.one_hot(ids, self.vocab_size, dtype=self._dtype)[:, None]
+        sts = self._inject_paged(states, table, live[:, None])
+        out, new_states = self._forward(params, variables, x, sts)
+        return out[:, -1, :], self._freeze_states(new_states, states, live)
+
     # -- chunked prefill programs ------------------------------------------
     def _slice_slot(self, states, slot):
-        """One slot's rows of every state leaf, batch dim kept at 1."""
+        """One slot's rows of every state leaf, batch dim kept at 1.
+        Paged page arrays pass through WHOLE by key (never sliced — they
+        are pool-wide, and sniffing on ``shape[0] == n_slots`` could
+        false-positive when the pool happens to hold n_slots+1 pages)."""
         def f(a):
             if hasattr(a, "ndim") and a.ndim >= 1 \
                     and a.shape[0] == self.n_slots:
                 return jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=0)
             return a
-        return jax.tree_util.tree_map(f, states)
+        out = {}
+        for key, st in states.items():
+            if isinstance(st, dict) and "k_pages" in st:
+                out[key] = {k: (v if k in ("k_pages", "v_pages") else f(v))
+                            for k, v in st.items()}
+            else:
+                out[key] = jax.tree_util.tree_map(f, st)
+        return out
 
     def _scatter_slot(self, states, sub, slot):
-        """Write a batch-1 state pytree back into one slot's rows."""
+        """Write a batch-1 state pytree back into one slot's rows. Paged
+        page arrays REPLACE the full-state ones (the batch-1 program
+        updated the shared pages in place, there is no row to scatter)."""
         def f(full, part):
             if hasattr(full, "ndim") and full.ndim >= 1 \
                     and full.shape[0] == self.n_slots:
                 return jax.lax.dynamic_update_slice_in_dim(
                     full, part, slot, axis=0)
             return part
-        return jax.tree_util.tree_map(f, states, sub)
+        out = {}
+        for key, st in states.items():
+            if isinstance(st, dict) and "k_pages" in st:
+                out[key] = {k: (sub[key][k] if k in ("k_pages", "v_pages")
+                                else f(v, sub[key][k]))
+                            for k, v in st.items()}
+            else:
+                out[key] = jax.tree_util.tree_map(f, st, sub[key])
+        return out
 
     def _prefill_fn(self, params, variables, slot, ids, n_real, states):
         """Prefill one chunk of ``ids`` (int32 [C], padded past ``n_real``)
@@ -559,13 +768,45 @@ class DecodeScheduler:
             probs = probs_all[n_real - 1]
         return probs, self._scatter_slot(states, new_sub, slot)
 
+    def _prefill_paged_fn(self, params, variables, slot, ids, n_real,
+                          table, states):
+        """Paged-mode chunk prefill: `_prefill_fn`'s dense path with the
+        chunk's K/V rows scattered into pool pages through the slot's
+        block table instead of a contiguous stripe. Lanes past ``n_real``
+        write to the scratch page (in-program mask from the traced
+        n_real — so padding allocates no blocks), and the scheduler
+        presents a table bucket covering the PADDED chunk end
+        (``pos + bucket``) so the layer's overflow guard never fires on
+        padding. One XLA program per (chunk bucket, table bucket)."""
+        s = slot[0]
+        nr = n_real[0]
+        sub = self._slice_slot(states, s)
+        trow = jax.lax.dynamic_slice_in_dim(table, s, 1, axis=0)  # [1, nb]
+        wmask = (jnp.arange(ids.shape[0], dtype=jnp.int32) < nr)[None, :]
+        sts = self._inject_paged(sub, trow, wmask)
+        x = jax.nn.one_hot(ids, self.vocab_size, dtype=self._dtype)[None]
+        out, new_sub = self._forward(params, variables, x, sts)
+        probs = jax.lax.dynamic_index_in_dim(out, nr - 1, axis=1,
+                                             keepdims=False)[0]
+        fixed = {}
+        for key, st in new_sub.items():
+            if isinstance(st, dict) and "k_pages" in st:
+                # the layer advanced pos by the PADDED chunk length; the
+                # sequence is only n_real tokens deeper (no overflow
+                # sentinel to preserve — paged bucketing covers the
+                # padded end by construction)
+                fixed[key] = {**st, "pos": sub[key]["pos"] + nr}
+            else:
+                fixed[key] = st
+        return probs, self._scatter_slot(states, fixed, s)
+
     def _pick_chunk(self, seq: _ActiveSeq) -> Tuple[int, int]:
         """(bucket, n_real) for this sequence's next prefill chunk, or
         (0, 0) when no bucket fits the KV-cache headroom (the tail then
         prefills token-by-token through the decode step)."""
         remaining = len(seq.prompt) - seq.fed
         n_real = min(remaining, self.prefill_chunk)
-        bucket = next(b for b in self.prefill_buckets if b >= n_real)
+        bucket = bucket_for(n_real, self.prefill_buckets)
         if self._cache_cap is not None and \
                 seq.fed + bucket > self._cache_cap:
             # padded writes past the cap would trip the layer's overflow
@@ -584,7 +825,10 @@ class DecodeScheduler:
         position, LSTM h/c) so an admitted sequence starts clean. Jitted:
         one fused device program per admission instead of one eager
         dispatch per leaf, and no implicit scalar transfers (``slot`` is
-        a 1-element int32 array, same contract as `_prefill_fn`)."""
+        a 1-element int32 array, same contract as `_prefill_fn`). Paged
+        page arrays are never touched — they are SHARED storage (another
+        slot's blocks live there); a fresh slot starts clean because its
+        table is reset to scratch host-side and its ``pos`` row to 0."""
         s = slot[0]
 
         def zero_row(a):
@@ -592,7 +836,49 @@ class DecodeScheduler:
                     a.shape[0] == self.n_slots:
                 return a.at[s].set(0)
             return a
-        return jax.tree_util.tree_map(zero_row, states)
+        out = {}
+        for key, st in states.items():
+            if isinstance(st, dict) and "k_pages" in st:
+                out[key] = {k: (v if k in ("k_pages", "v_pages")
+                                else zero_row(v))
+                            for k, v in st.items()}
+            else:
+                out[key] = jax.tree_util.tree_map(zero_row, st)
+        return out
+
+    def _setpos_fn(self, states, slot, val):
+        """Set one slot's attention cache position (paged prefix restore:
+        the remap is host-side table surgery; the only device-visible
+        effect is ``pos`` jumping past the hit). 1-element int32 array
+        args, same transfer contract as `_zero_fn`."""
+        s = slot[0]
+        v = val[0]
+        out = {}
+        for key, st in states.items():
+            if isinstance(st, dict) and "k_pages" in st:
+                out[key] = {**st, "pos": st["pos"].at[s].set(v)}
+            else:
+                out[key] = st
+        return out
+
+    def _cow_fn(self, states, src, dst):
+        """Copy-on-write block duplication: copy page ``src`` into the
+        freshly-allocated page ``dst`` across every layer's K/V pages.
+        Dispatched host-side BEFORE a write that would land in a shared
+        (trie-owned) block; the writer's table then points at ``dst``."""
+        s = src[0]
+        d = dst[0]
+        out = {}
+        for key, st in states.items():
+            if isinstance(st, dict) and "k_pages" in st:
+                out[key] = {
+                    **st,
+                    "k_pages": st["k_pages"].at[d].set(st["k_pages"][s]),
+                    "v_pages": st["v_pages"].at[d].set(st["v_pages"][s]),
+                }
+            else:
+                out[key] = st
+        return out
 
     def _reset_slot_state(self, slot: int) -> None:
         self._states = self._jzero(self._states, device_index(slot))
@@ -615,7 +901,7 @@ class DecodeScheduler:
         seq.pool_node = node  # holds one reference until the slot frees
         if not n_blk:
             return
-        bucket = next(b for b in self.restore_buckets if b >= n_blk)
+        bucket = bucket_for(n_blk, self.restore_buckets)
         idx = np.full((bucket,), SCRATCH_BLOCK, np.int32)
         idx[:n_blk] = ids
         self._states = self._jrestore(
@@ -658,6 +944,217 @@ class DecodeScheduler:
                 self.pool.storage)
             off += b
 
+    # -- paged mode: block tables, lazy alloc, COW, preempt-and-swap -------
+    def _blocks_for(self, positions: int) -> int:
+        return -(-positions // self.kv_block)
+
+    def _table_for(self, max_pos: int) -> np.ndarray:
+        """The host table sliced to the pow2 bucket covering ``max_pos``
+        positions — the per-step program shape. Shallow workloads gather
+        (and attend over) only their own depth, not the whole pool."""
+        nb = bucket_for(max(1, self._blocks_for(max_pos)),
+                        self.table_buckets)
+        return self._table[:, :nb]
+
+    def _alloc_or_preempt(self, slot: int,
+                          seq: _ActiveSeq) -> Optional[int]:
+        """Claim one pool block under the preempt-and-swap policy: when
+        allocation fails even after LRU-evicting unreferenced cached
+        blocks, the LATEST-submitted live slot is preempted and the claim
+        retried. None means ``seq`` itself was the victim (it is already
+        requeued — the caller must skip its dispatch). The single home
+        of the pool-pressure policy, shared by lazy growth and COW."""
+        while True:
+            bid = self.pool.alloc()
+            if bid is not None:
+                return bid
+            victim = self._pick_victim()
+            if victim is None or victim[1] is seq:
+                self._preempt(slot, seq)
+                return None
+            self._preempt(*victim)
+
+    def _ensure_blocks(self, slot: int, seq: _ActiveSeq,
+                       upto_pos: int) -> bool:
+        """Grow ``slot``'s block table to cover positions [0, upto_pos)
+        — the lazy allocation of the paged layout: a block is claimed
+        only when ``pos`` is about to cross into it. False means ``seq``
+        was preempted by its own allocation (see _alloc_or_preempt)."""
+        need = self._blocks_for(upto_pos)
+        added = 0
+        while len(seq.block_ids) < need:
+            bid = self._alloc_or_preempt(slot, seq)
+            if bid is None:
+                return False
+            j = len(seq.block_ids)
+            seq.block_ids.append(bid)
+            seq.shared.append(False)
+            self._table[slot, j] = bid
+            added += 1
+        if added and self.tracer.enabled:
+            self.tracer.instant(
+                "block_alloc", track=self._slot_tracks[slot],
+                args={"request": seq.handle.request_id, "blocks": added,
+                      "free": self.pool.free_blocks})
+        return True
+
+    def _ensure_writable(self, slot: int, seq: _ActiveSeq,
+                         pos: int) -> bool:
+        """Copy-on-write before the first write into a SHARED block: a
+        restored (trie-owned) block the slot is about to write — the
+        one-token refeed when a prefix hit covers the whole prompt —
+        is duplicated into a fresh page and the table repointed, so the
+        cached original stays bit-intact for its other readers. Only the
+        first block of a write span can be shared (everything past the
+        restore frontier was freshly allocated)."""
+        j = pos // self.kv_block
+        if j >= len(seq.block_ids) or not seq.shared[j]:
+            return True
+        bid = self._alloc_or_preempt(slot, seq)
+        if bid is None:
+            # self-preempted for the COW page: when every page backs
+            # this prompt's own (pinned) prefix, no amount of retrying
+            # can produce the duplicate — the resume must restore one
+            # block short instead
+            seq.cow_starved = True
+            return False
+        src = seq.block_ids[j]
+        self._states = self._jcow(self._states, device_index(src),
+                                  device_index(bid))
+        seq.block_ids[j] = bid
+        seq.shared[j] = False
+        self._table[slot, j] = bid
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "block_cow", track=self._slot_tracks[slot],
+                args={"request": seq.handle.request_id, "src": src,
+                      "dst": bid, "block_index": j})
+        return True
+
+    def _pick_victim(self) -> Optional[Tuple[int, _ActiveSeq]]:
+        """Preemption victim: the latest-SUBMITTED live slot (LIFO — the
+        earliest request keeps its progress, vLLM's policy). Keyed on
+        t_submit, not t_admitted: re-admission re-stamps t_admitted, so
+        an admitted-time key would make a just-resumed old request the
+        preferred victim again and thrash its re-prefill. May be the
+        requester itself when it is the youngest."""
+        cands = [(s.handle.t_submit, i, s)
+                 for i, s in enumerate(self._slots) if s is not None]
+        if not cands:
+            return None
+        _, i, s = max(cands)
+        return i, s
+
+    def _preempt(self, slot: int, seq: _ActiveSeq) -> None:
+        """Swap a sequence out under pool pressure: release its owned
+        blocks and trie pin (KV is dropped, not spilled — recompute is a
+        prefill, which chunking makes cheap), fold the tokens generated
+        so far into its prompt, and requeue it at the FRONT. On
+        re-admission the re-prefill recomputes the same K/V and the
+        final chunk's distribution yields exactly the token the
+        interrupted decode would have produced next — the sequence's
+        host-side RNG is untouched, so resumed output is token-identical
+        to an unpreempted run."""
+        self._m_preempted.inc()
+        h = seq.handle
+        tr = self.tracer
+        if tr.enabled:
+            if seq.phase == "prefill":
+                tr.end("prefill", req=h.request_id,
+                       args={"fed_tokens": seq.fed})
+            elif seq.phase == "decode":
+                tr.end("decode", req=h.request_id,
+                       args={"tokens": len(h.tokens), "preempted": True})
+            tr.instant("preempt", track=self._slot_tracks[slot],
+                       args={"request": h.request_id,
+                             "blocks_released": sum(
+                                 1 for sh in seq.shared if not sh),
+                             "tokens_done": len(h.tokens)})
+            # the swap gap on the request track: everything between
+            # "preempt" and the matching "resume" is time the request
+            # spent swapped out waiting for pool blocks
+            tr.begin("preempted", req=h.request_id)
+        self._release_pool(seq)
+        self._release_slot_blocks(slot, seq)
+        seq.prompt.extend(int(t) for t in h.tokens[seq.folded:])
+        seq.folded = len(h.tokens)
+        seq.fed = 0
+        seq.written = 0
+        seq.phase = "preempted"
+        seq.resumed = True
+        # single-writer: _slots is mutated only on this scheduler thread
+        # (same discipline as _step_once); _cond guards only the queue
+        self._slots[slot] = None  # graftlint: disable=CC004
+        with self._cond:
+            self._queue.insert(0, seq)
+            self._m_queue_depth.set(len(self._queue))
+        self._m_active.set(sum(s is not None for s in self._slots))
+
+    def _release_slot_blocks(self, slot: int, seq: _ActiveSeq,
+                             keep: frozenset = frozenset()) -> None:
+        """Return a slot's OWNED blocks to the pool (shared entries are
+        trie-owned — releasing the trie pin is `_release_pool`'s job)
+        and reset its table row to scratch. ``keep``: ids adopted by the
+        trie at publish (ownership already transferred)."""
+        for bid, sh in zip(seq.block_ids, seq.shared):
+            if not sh and bid not in keep:
+                self.pool.free_block(bid)
+        seq.block_ids = []
+        seq.shared = []
+        self._table[slot, :] = SCRATCH_BLOCK
+
+    def _try_restore_paged(self, slot: int, seq: _ActiveSeq) -> None:
+        """Paged prefix restore = block-table remap: point the slot's
+        table at the cached blocks (refcounted via the trie pin) and set
+        ``pos`` past the hit. ZERO K/V copies — the pages are referenced
+        where they lie; the only device work is the one-row pos write.
+        The hit may cover the WHOLE prompt (full blocks): the last
+        prompt token is then re-fed to produce the first output
+        distribution, and its write copy-on-writes the final shared
+        block (`_ensure_writable`)."""
+        B = self.pool.block
+        self._m_prefix_lookups.inc()
+        self._m_prefix_lookup_tokens.inc(len(seq.prompt))
+        max_hit = len(seq.prompt) // B
+        if seq.cow_starved:
+            # the previous attempt's full hit left no page for the
+            # refeed's COW duplicate: leave the tail block unpinned (it
+            # becomes evictable, freeing the page the re-prefill needs).
+            # One-shot — a later ordinary preempt/resume gets the full
+            # hit again; if the trap recurs the flag is simply re-set
+            max_hit -= 1
+            seq.cow_starved = False
+        if max_hit < 1:
+            return
+        n_blk, ids, node = self.pool.match(seq.prompt, max_hit)
+        seq.pool_node = node  # holds one reference until the slot frees
+        if not n_blk:
+            return
+        seq.block_ids = [int(b) for b in ids]
+        seq.shared = [True] * n_blk
+        self._table[slot, :n_blk] = ids
+        fed = min(n_blk * B, len(seq.prompt) - 1)
+        self._states = self._jsetpos(self._states, device_index(slot),
+                                     device_index(fed))
+        seq.fed = fed
+        seq.written = fed
+        self._m_prefix_hits.inc()
+        self._m_prefix_hit_tokens.inc(fed)
+
+    def _publish_paged(self, slot: int, seq: _ActiveSeq) -> frozenset:
+        """Zero-copy publish: the finished sequence's full prompt blocks
+        are ADOPTED by the trie in place (ownership transfer — the pages
+        already hold the prefill-written K/V). Returns the transferred
+        ids so the slot release does not free them. Blocks the trie
+        already indexes (the restored prefix, or a COW'd duplicate of
+        one) are skipped and freed normally."""
+        B = self.pool.block
+        n_full = len(seq.prompt) // B
+        if n_full < 1 or n_full > len(seq.block_ids):
+            return frozenset()
+        return frozenset(self.pool.adopt(
+            seq.prompt[:n_full * B], seq.block_ids[:n_full]))
+
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: Optional[int] = None,
@@ -679,8 +1176,27 @@ class DecodeScheduler:
             raise ValueError(
                 f"prompt ids out of range [0, {self.vocab_size}): "
                 f"{bad[:5]}")
-        if self._cache_cap is not None:
-            needed = len(prompt_ids) + max(max_new_tokens - 1, 0)
+        needed = len(prompt_ids) + max(max_new_tokens - 1, 0)
+        if self.paged:
+            # pool-bytes admission: a prompt is rejected only when it
+            # cannot fit the WHOLE pool — there is no per-slot stripe to
+            # outgrow, so "too long" means more blocks than exist
+            blocks_needed = self._blocks_for(needed)
+            if blocks_needed > self.pool.capacity_blocks:
+                self._m_rejected.inc()
+                self.tracer.instant("reject", req=rid, args={
+                    "request_id": rid, "reason": "prompt_too_long",
+                    "blocks_needed": blocks_needed,
+                    "blocks_available": self.pool.capacity_blocks})
+                err = PromptTooLongError(
+                    f"prompt ({len(prompt_ids)}) + max_new_tokens "
+                    f"({max_new_tokens}) needs {blocks_needed} KV blocks "
+                    f"of {self.kv_block} positions but the pool has "
+                    f"{self.pool.capacity_blocks}")
+                err.blocks_needed = blocks_needed
+                err.blocks_available = self.pool.capacity_blocks
+                raise err
+        elif self._cache_cap is not None:
             if needed > self._cache_cap:
                 # rejected up front (HTTP 413 at the serving layer), not
                 # admitted to die mid-decode on the attention layer's
@@ -766,12 +1282,25 @@ class DecodeScheduler:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        # a pool-pressure preemption racing the drain above can requeue
+        # a slot-resident sequence AFTER _queue was cleared; drain once
+        # more now that the scheduler thread (the only other writer) is
+        # joined, or that handle would never finish and its caller's
+        # result() would block out its full timeout
+        with self._cond:
+            pending = self._queue[:]
+            self._queue.clear()
+        for seq in pending:
+            seq.handle._finish(RuntimeError("scheduler stopped"))
+            self._trace_done("cancel", seq)
         # safe lock-free: the scheduler thread (the only other _slots
         # writer) has been joined above
         for i, seq in enumerate(self._slots):  # graftlint: disable=CC004
             if seq is not None:
                 if self.pool is not None:
                     self._release_pool(seq)
+                    if self.paged:
+                        self._release_slot_blocks(i, seq)
                 seq.handle._finish(RuntimeError("scheduler stopped"))
                 self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
@@ -790,10 +1319,16 @@ class DecodeScheduler:
         tr = self.tracer
         if not tr.enabled:
             return
-        if h.t_admitted is None:
+        # seq.phase (not the handle timestamps) names the open span: a
+        # resumed sequence is back in "prefill" with t_first_token long
+        # stamped, and one cancelled while swapped out has "preempted"
+        # open instead of "queued"
+        if seq.phase == "queued":
             tr.end("queued", req=rid)
-        elif h.t_first_token is None:
+        elif seq.phase == "prefill":
             tr.end("prefill", req=rid, args={"fed_tokens": seq.fed})
+        elif seq.phase == "preempted":
+            tr.end("preempted", req=rid)
         else:
             tr.end("decode", req=rid,
                    args={"tokens": len(h.tokens), "iterations": seq.steps})
@@ -814,26 +1349,67 @@ class DecodeScheduler:
                     # keeps refcounts leak-free (nothing is published:
                     # the prompt may be half-written)
                     self._release_pool(seq)
+                    if self.paged:
+                        self._release_slot_blocks(i, seq)
                 seq.handle._finish()  # partial tokens, caller already left
                 self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
 
+    def _pool_can_admit(self, seq: _ActiveSeq,
+                        reclaim_memo: List[Optional[int]],
+                        pending_blocks: int) -> bool:
+        """Paged admission gate: only admit when the pool could actually
+        back the prompt's prefill (free + evictable blocks) — admitting
+        past that point would just preempt a live slot to make room.
+        Always True when no slot is live (eviction alone must then cover
+        it: submit() checked the prompt fits the whole pool).
+        ``reclaim_memo`` caches the two-trie-walk reclaimable count for
+        one _admit pass — nothing mutates the pool under _cond, so one
+        walk per pass is exact, not stale. ``pending_blocks`` is what
+        this pass's earlier admissions will claim when they prefill
+        (they have not allocated yet), so co-admitted prompts cannot
+        jointly overcommit the pool and trigger the admit-then-preempt
+        churn this gate exists to prevent."""
+        if not self.paged:
+            return True
+        if not any(s is not None for s in self._slots):
+            return True
+        if reclaim_memo[0] is None:
+            reclaim_memo[0] = self.pool.reclaimable_blocks()
+        return (reclaim_memo[0] - pending_blocks
+                >= self._blocks_for(len(seq.prompt)))
+
     def _admit(self) -> None:
         admitted: List[Tuple[int, _ActiveSeq]] = []
         tr = self.tracer
+        reclaim_memo: List[Optional[int]] = [None]
+        pending_blocks = 0  # blocks this pass's admissions will claim
         with self._cond:
+            blocked = False
             for i in range(self.n_slots):
-                if self._slots[i] is not None:
+                if blocked or self._slots[i] is not None:
                     continue
                 while self._queue:
-                    seq = self._queue.pop(0)
+                    seq = self._queue[0]
                     if seq.handle.cancelled():  # gave up while queued
+                        self._queue.pop(0)
                         self._m_cancelled.inc()
                         seq.handle._finish()
                         self._trace_done("cancel", seq)
                         continue
+                    if not self._pool_can_admit(seq, reclaim_memo,
+                                                pending_blocks):
+                        # head-of-line blocking is deliberate: skipping
+                        # ahead would starve the (front-requeued)
+                        # preempted sequence the gate exists to protect
+                        blocked = True
+                        break
+                    self._queue.pop(0)
                     self._slots[i] = seq
-                    self._m_seqs.inc()
+                    if self.paged:
+                        pending_blocks += self._blocks_for(len(seq.prompt))
+                    if not seq.resumed:
+                        self._m_seqs.inc()
                     admitted.append((i, seq))
                     break
             self._m_queue_depth.set(len(self._queue))
@@ -847,19 +1423,31 @@ class DecodeScheduler:
             h = seq.handle
             rid = h.request_id
             h.t_admitted = time.monotonic()
-            tr.end("queued", req=rid)
+            if seq.phase == "preempted":
+                tr.end("preempted", req=rid)
+                tr.instant("resume", track=self._slot_tracks[i],
+                           args={"request": rid,
+                                 "refeed_tokens": len(seq.prompt)})
+            else:
+                tr.end("queued", req=rid)
             tr.instant("admit", track=self._slot_tracks[i],
                        args={"request": rid})
             tr.begin("prefix_restore", req=rid)
             self._reset_slot_state(i)
             if self.pool is not None:
-                self._try_restore(i, seq)
+                if self.paged:
+                    self._try_restore_paged(i, seq)
+                else:
+                    self._try_restore(i, seq)
             h.t_restored = time.monotonic()
             tr.end("prefix_restore", req=rid,
-                   args={"hit_tokens": seq.fed, "slot": i})
+                   args={"hit_tokens": seq.fed, "slot": i,
+                         **({"remap_blocks": len(seq.block_ids),
+                             "kv_copies": 0} if self.paged else {})})
             tr.begin("prefill", req=rid,
                      args={"prompt_tokens": len(seq.prompt),
                            "restored_tokens": seq.fed, "slot": i})
+            seq.phase = "prefill"
 
     def _consume(self, slot: int, seq: _ActiveSeq,
                  probs_row: np.ndarray) -> None:
@@ -879,18 +1467,30 @@ class DecodeScheduler:
             h.t_first_token = now
             h.steps_to_first_token = seq.steps
             self._m_ttft.record(now - h.t_submit)
+        if seq.phase == "prefill":
             # phase boundary on the request track: prompt ingestion is
-            # over the moment the first output token exists
+            # over the moment the first output token exists. Keyed on
+            # seq.phase, not t_first_token — a RESUMED sequence re-runs
+            # prefill with its first-token timestamp long stamped
             self.tracer.end("prefill", req=h.request_id,
                             args={"steps": seq.steps})
             self.tracer.begin("decode", req=h.request_id)
+            seq.phase = "decode"
         if (len(h.tokens) >= h.max_new_tokens
                 or (seq.eos_id is not None and tok == seq.eos_id)):
             if self.pool is not None:
                 # retain the prompt's prefill-written blocks for the next
-                # request sharing this prefix, then drop our own pin
-                self._publish_prompt(slot, seq)
-                self._release_pool(seq)
+                # request sharing this prefix, then drop our own pin.
+                # Paged: pure ownership transfer (trie adopts the pages
+                # in place); contiguous: jitted scatter into the side
+                # pool's storage
+                if self.paged:
+                    adopted = self._publish_paged(slot, seq)
+                    self._release_pool(seq)
+                    self._release_slot_blocks(slot, seq, keep=adopted)
+                else:
+                    self._publish_prompt(slot, seq)
+                    self._release_pool(seq)
             h._finish()
             self._trace_done("finish", seq, slot=slot)
             self._m_latency.record(now - h.t_submit)
@@ -909,6 +1509,13 @@ class DecodeScheduler:
             bucket, n_real = self._pick_chunk(seq)
             if not n_real:
                 continue  # no cache headroom: token-by-token fallback
+            if self.paged:
+                # lazy allocation + COW happen HERE, host-side, before
+                # the program runs: every block the chunk really writes
+                # is allocated and exclusively owned by dispatch time
+                if not self._ensure_blocks(i, seq, seq.written + n_real) \
+                        or not self._ensure_writable(i, seq, seq.written):
+                    continue  # seq itself was preempted for blocks
             ids = np.zeros((bucket,), np.int32)
             ids[:n_real] = seq.prompt[seq.fed:seq.fed + n_real]
             if self.tracer.enabled:  # keep tracing-off allocation-free
@@ -916,10 +1523,21 @@ class DecodeScheduler:
                                   track=self._slot_tracks[i],
                                   args={"request": seq.handle.request_id,
                                         "bucket": bucket, "tokens": n_real})
-            probs, self._states = self._jprefill(
-                self.net.params, self.net.variables,
-                device_index(i), jnp.asarray(ids),
-                device_index(n_real), self._states)
+            if self.paged:
+                # table bucket covers the PADDED chunk end so the
+                # layer's overflow guard never trips on pad lanes
+                probs, self._states = self._jprefill(
+                    self.net.params, self.net.variables,
+                    device_index(i), jnp.asarray(ids),
+                    device_index(n_real),
+                    jnp.asarray(self._table_for(seq.written + bucket)),
+                    self._states)
+                seq.written += n_real
+            else:
+                probs, self._states = self._jprefill(
+                    self.net.params, self.net.variables,
+                    device_index(i), jnp.asarray(ids),
+                    device_index(n_real), self._states)
             seq.fed += n_real
             seq.steps += 1
             self._m_prefill_tokens.inc(n_real)
@@ -956,12 +1574,23 @@ class DecodeScheduler:
         # prefill for slots chunked prefill cannot serve (disabled, or
         # no bucket fits the remaining cache headroom)
         fed: List[Tuple[int, _ActiveSeq]] = []
-        for i, seq in active:
+        # oldest-first (same t_submit key as _pick_victim): a
+        # pool-pressure preemption always victimizes the LATEST-submitted
+        # slot, which is processed last here — so an already-vetted
+        # candidate can never lose its blocks to a later one's allocation
+        # (its removal would leave a stale fed entry writing into freed
+        # pages)
+        cands = sorted(active, key=lambda e: e[1].handle.t_submit)
+        for i, seq in cands:
             if self._slots[i] is not seq or i == chunked:
-                continue  # evicted above / consumed its iteration
+                continue  # evicted/preempted above / consumed its turn
             if not seq.sampling and self.prefill_buckets \
                     and self._pick_chunk(seq)[1]:
                 continue  # mid-prefill: waits for its chunk turn
+            if self.paged:
+                if not self._ensure_blocks(i, seq, seq.written + 1) \
+                        or not self._ensure_writable(i, seq, seq.written):
+                    continue  # seq itself was preempted for blocks
             fed.append((i, seq))
         if fed:
             ids = np.zeros((self.n_slots,), np.int32)
@@ -972,13 +1601,21 @@ class DecodeScheduler:
             if self.tracer.enabled:  # keep tracing-off allocation-free
                 self.tracer.begin("decode_step", track=self._sched_track,
                                   args={"live_slots": len(fed)})
-            probs, new_states = self._jstep(
-                self.net.params, self.net.variables, jnp.asarray(ids),
-                jnp.asarray(live), self._states)
+            if self.paged:
+                table = self._table_for(max(s.written + 1
+                                            for _, s in fed))
+                probs, new_states = self._jstep(
+                    self.net.params, self.net.variables, jnp.asarray(ids),
+                    jnp.asarray(live), jnp.asarray(table), self._states)
+            else:
+                probs, new_states = self._jstep(
+                    self.net.params, self.net.variables, jnp.asarray(ids),
+                    jnp.asarray(live), self._states)
             self._states = new_states
             probs = host_read(probs)
             for i, seq in fed:
                 seq.steps += 1
+                seq.written += 1
                 was_sampling = seq.sampling
                 if seq.fed < len(seq.prompt):
                     seq.fed += 1

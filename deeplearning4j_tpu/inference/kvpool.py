@@ -1,12 +1,38 @@
-"""Prefix KV reuse: block-pooled KV store + radix-trie prefix index.
+"""Block-pooled KV store: paged live-decode backing + radix-trie prefix index.
 
 Real serving traffic is dominated by shared prompt prefixes (system
-prompts, few-shot templates, chat history), yet every admitted sequence
-used to pay the full chunked prefill (engine.py) even when an identical
-prefix was computed seconds ago in another slot. This module is the
-block-level KV management of modern inference engines (vLLM's
-PagedAttention block tables, SGLang's RadixAttention prefix tree) adapted
-to this engine's per-slot *contiguous* cache layout:
+prompts, few-shot templates, chat history) and wildly mixed prompt
+lengths, yet the decode scheduler used to hand every slot a contiguous
+``max_cache_len`` stripe of K/V — HBM cost ``slots × max_cache_len``
+regardless of actual lengths. This module is the block-level KV
+management of modern inference engines (vLLM's PagedAttention block
+tables, SGLang's RadixAttention prefix tree), in two modes:
+
+**Paged mode** (``paged=True`` — the ISSUE 6 tentpole): the pool IS the
+live decode cache. The engine owns one pool-wide page array per layer
+(``k_pages``/``v_pages``: ``[capacity+1, block, Hkv, Dh]``) and gives
+each slot an int32 *block table* mapping logical block index → page row;
+the jitted decode/prefill programs read and write K/V through the table
+(`nn/layers/attention.py` paged step). The pool object holds only the
+host-side metadata: the free list, the trie, and per-node refcounts.
+Consequences that fall out of the layout:
+
+  - slot capacity is bounded by total pool bytes, not
+    ``slots × max_cache_len`` — dozens of short sequences share the
+    pages one long one would have monopolized;
+  - prefix restore is a **block-table remap**: cached blocks are
+    *referenced*, never gathered (zero K/V copies), with copy-on-write
+    on the first write into a shared block;
+  - publish at finish is the same move in reverse: the slot's full
+    prompt blocks are *adopted* by the trie (ownership transfer, no
+    scatter);
+  - under pool pressure the scheduler preempts the latest-submitted slot
+    (blocks released, sequence requeued) and resumes it later.
+
+**Contiguous mode** (``paged=False`` — the ISSUE 4 layout, kept as the
+token-identity reference and for nets the paged path cannot serve): a
+side pool caching completed prompts' K/V, restored into the slot's
+contiguous stripe by a jitted block-gather:
 
   - :class:`KVPool` — per-layer K/V storage carved into fixed-size blocks
     of ``block`` positions, preallocated under a byte budget (index 0 is a
@@ -90,14 +116,21 @@ class KVPool:
     budget covers EVERYTHING the pool allocates (scratch block included):
     ``capacity_blocks`` usable blocks cost
     ``(capacity_blocks + 1) * bytes_per_block <= budget_bytes``.
+
+    ``paged=True``: the engine owns the page arrays (they live inside
+    its jitted state pytree, where the programs scatter/gather them);
+    this object allocates NOTHING on device and becomes pure metadata —
+    free list, trie, refcounts — plus the ``kv_pool_*`` gauges.
     """
 
     def __init__(self, attn_states: Dict, *, block: int, budget_bytes: int,
+                 paged: bool = False,
                  metrics: Optional[MetricsRegistry] = None,
                  tracer: Optional[FlightRecorder] = None):
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
         self.block = int(block)
+        self.paged = bool(paged)
         # flight recorder (trace.py): eviction/publish instants on the
         # `kvpool` track; None (standalone pool) records nothing
         self._tracer = tracer
@@ -115,7 +148,7 @@ class KVPool:
         # one block of the budget is the scratch row
         self.capacity_blocks = max(0, int(total) - 1)
         self.storage: Dict = {}
-        if self.capacity_blocks > 0:
+        if self.capacity_blocks > 0 and not self.paged:
             n = self.capacity_blocks + 1
             self.storage = {
                 key: {"k": jnp.zeros((n, self.block) + row_shape, dtype),
@@ -125,18 +158,40 @@ class KVPool:
         self._root = _Node((), SCRATCH_BLOCK, None)
         self._clock = 0  # logical LRU clock (monotonic per pool op)
         self._metrics = metrics
+        self._g_live = self._g_free = None
         if metrics is not None:
             self._m_evicted = metrics.counter(
                 "prefix_cache_evicted_blocks_total")
-            self._m_used = metrics.gauge("prefix_cache_used_bytes")
-            cap = metrics.gauge("prefix_cache_capacity_bytes")
-            cap.set((self.capacity_blocks + 1) * per_block
-                    if self.capacity_blocks else 0)
+            if self.paged:
+                # unified-pool occupancy: live = every allocated block
+                # (slot-owned + trie-cached), free = the free list. The
+                # utilization ratio is derived at snapshot time so it can
+                # never go stale between scrapes.
+                self._g_live = metrics.gauge("kv_pool_blocks_live")
+                self._g_free = metrics.gauge("kv_pool_blocks_free")
+                cap_g = metrics.gauge("kv_pool_blocks_capacity")
+                cap_g.set(self.capacity_blocks)
+                metrics.ratio("kv_pool_utilization", self._g_live, cap_g)
+                self._sync_gauges()
+            else:
+                self._m_used = metrics.gauge("prefix_cache_used_bytes")
+                cap = metrics.gauge("prefix_cache_capacity_bytes")
+                cap.set((self.capacity_blocks + 1) * per_block
+                        if self.capacity_blocks else 0)
 
     # -- host-side bookkeeping ---------------------------------------------
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
+
+    def _sync_gauges(self) -> None:
+        if self._g_live is not None:
+            self._g_live.set(self.used_blocks)
+            self._g_free.set(len(self._free))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
 
     @property
     def used_blocks(self) -> int:
@@ -165,13 +220,13 @@ class KVPool:
             stack.extend(n.children.values())
 
     # -- prefix lookup ------------------------------------------------------
-    def match(self, tokens: Sequence[int], max_blocks: int
-              ) -> Tuple[int, List[int], Optional[_Node]]:
-        """Longest cached prefix of ``tokens``, capped at ``max_blocks``
-        full blocks. Returns ``(n_blocks, block_ids, node)`` and takes one
-        reference on the deepest matched node (release with
-        :meth:`release` when the sequence leaves its slot); no hit returns
-        ``(0, [], None)`` and takes no reference."""
+    def _walk_prefix(self, tokens: Sequence[int], max_blocks: int
+                     ) -> Tuple[_Node, List[int]]:
+        """Descend the deepest cached prefix of ``tokens`` (full blocks
+        only, capped at ``max_blocks``), ticking ``last_access`` on the
+        path — the single definition of the trie walk shared by
+        :meth:`match` / :meth:`insert` / :meth:`adopt`. Returns the
+        deepest node and the block ids along the path."""
         node, ids = self._root, []
         B = self.block
         while len(ids) < max_blocks:
@@ -182,6 +237,16 @@ class KVPool:
             node = child
             node.last_access = self._tick()
             ids.append(node.block_id)
+        return node, ids
+
+    def match(self, tokens: Sequence[int], max_blocks: int
+              ) -> Tuple[int, List[int], Optional[_Node]]:
+        """Longest cached prefix of ``tokens``, capped at ``max_blocks``
+        full blocks. Returns ``(n_blocks, block_ids, node)`` and takes one
+        reference on the deepest matched node (release with
+        :meth:`release` when the sequence leaves its slot); no hit returns
+        ``(0, [], None)`` and takes no reference."""
+        node, ids = self._walk_prefix(tokens, max_blocks)
         if not ids:
             return 0, [], None
         node.lock += 1
@@ -191,6 +256,71 @@ class KVPool:
         if node.lock <= 0:
             raise AssertionError("release() without a matching reference")
         node.lock -= 1
+
+    # -- paged mode: the pool as the live decode cache ----------------------
+    def alloc(self) -> Optional[int]:
+        """One free block for a slot's table (lazy allocation as ``pos``
+        crosses a block boundary), LRU-evicting unreferenced cached
+        blocks under pressure. ``None`` means even eviction could not
+        free a block — every block is owned by a live slot or pinned,
+        and the scheduler must preempt. The returned block is OWNED by
+        the caller: it is in no trie node and no free list, so nothing
+        else can touch it until `free_block` or `adopt`."""
+        bid = self._alloc()
+        self._sync_gauges()
+        return bid
+
+    def free_block(self, block_id: int) -> None:
+        """Return a slot-owned block (never a trie-owned one — those are
+        freed by eviction) to the free list."""
+        if block_id == SCRATCH_BLOCK:
+            raise AssertionError("the scratch block is never owned")
+        self._free.append(block_id)
+        self._sync_gauges()
+
+    def adopt(self, tokens: Sequence[int], block_ids: Sequence[int]
+              ) -> List[int]:
+        """Zero-copy publish: index ``tokens``'s full blocks by
+        REFERENCE. ``block_ids[j]`` is the slot-owned page already
+        holding block ``j``'s K/V (the slot's table — prefill wrote the
+        pages in place, so there is nothing to scatter). Walks the
+        existing trie prefix, attaches a node per missing block that
+        simply takes over the caller's page, and returns the adopted
+        ids — the caller must NOT free those (ownership moved to the
+        trie; eviction frees them eventually)."""
+        B = self.block
+        n_total = len(tokens) // B
+        node, matched = self._walk_prefix(tokens, n_total)
+        i = len(matched)
+        adopted: List[int] = []
+        for j in range(i, n_total):
+            key = tuple(int(t) for t in tokens[j * B:(j + 1) * B])
+            child = _Node(key, int(block_ids[j]), node)
+            node.children[key] = child
+            node = child
+            node.last_access = self._tick()
+            adopted.append(int(block_ids[j]))
+        if adopted and self._tracer is not None:
+            self._tracer.instant("pool_publish", track="kvpool",
+                                 args={"blocks": len(adopted),
+                                       "used_blocks": self.used_blocks,
+                                       "zero_copy": True})
+        return adopted
+
+    def reclaimable_blocks(self) -> int:
+        """Free blocks plus cached blocks eviction could actually free
+        (everything not on a pinned trie path) — the scheduler's
+        admission gate: admitting a prompt needing more than this would
+        immediately preempt a live slot."""
+        pinned = set()
+        for n in self._walk():
+            if n.lock:
+                p = n
+                while p is not None and id(p) not in pinned:
+                    pinned.add(id(p))
+                    p = p.parent
+        return len(self._free) + sum(
+            1 for n in self._walk() if id(n) not in pinned)
 
     # -- insertion / eviction ----------------------------------------------
     def insert(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
@@ -204,16 +334,8 @@ class KVPool:
         referenced), the suffix is simply not cached."""
         B = self.block
         n_total = len(tokens) // B
-        node, i = self._root, 0
-        while i < n_total:
-            child = node.children.get(
-                tuple(int(t) for t in tokens[i * B:(i + 1) * B]))
-            if child is None:
-                break
-            node = child
-            node.last_access = self._tick()
-            i += 1
-        start, new_ids, pinned = i, [], []
+        node, matched = self._walk_prefix(tokens, n_total)
+        start, new_ids, pinned = len(matched), [], []
         if node is not self._root:
             node.lock += 1  # pin the extension point against eviction
             pinned.append(node)
@@ -239,7 +361,10 @@ class KVPool:
             for n in pinned:
                 n.lock -= 1
         if self._metrics is not None:
-            self._m_used.set(self.used_bytes)
+            if self.paged:
+                self._sync_gauges()
+            else:
+                self._m_used.set(self.used_bytes)
         if new_ids and self._tracer is not None:
             self._tracer.instant("pool_publish", track="kvpool",
                                  args={"blocks": len(new_ids),
@@ -273,7 +398,10 @@ class KVPool:
                                (parent.last_access, id(parent), parent))
         if freed and self._metrics is not None:
             self._m_evicted.inc(freed)
-            self._m_used.set(self.used_bytes)
+            if self.paged:
+                self._sync_gauges()
+            else:
+                self._m_used.set(self.used_bytes)
         if freed and self._tracer is not None:
             self._tracer.instant("pool_evict", track="kvpool",
                                  args={"blocks": freed,

@@ -206,11 +206,13 @@ class MetricsRegistry:
                 self._histograms[name] = Histogram(name, **kw)
             return self._histograms[name]
 
-    def ratio(self, name: str, numerator: Counter,
-              denominator: Counter) -> None:
-        """Register a derived numerator/denominator gauge (e.g. the
-        prefix-cache hit rate = hit tokens / looked-up tokens). Evaluated
-        fresh at every snapshot; an empty denominator reads as 0.0."""
+    def ratio(self, name: str, numerator, denominator) -> None:
+        """Register a derived numerator/denominator instrument — any two
+        objects with a ``.value`` (Counter OR Gauge): the prefix-cache
+        hit rate is hit-token / looked-up-token counters, the paged-KV
+        ``kv_pool_utilization`` is live-blocks / capacity gauges.
+        Evaluated fresh at every snapshot so it can never go stale
+        between scrapes; an empty denominator reads as 0.0."""
         with self._lock:
             self._ratios[name] = (numerator, denominator)
 

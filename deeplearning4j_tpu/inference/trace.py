@@ -37,6 +37,15 @@ Record taxonomy (the span tree every request gets):
   events (via `analysis.runtime.CompileCounter` cache-size deltas), and
   ``reject`` instants for backpressure 503s / 413s / 504s.
 
+  Paged-KV engines (engine.paged) add block-lifecycle instants on the
+  slot tracks — ``block_alloc`` (lazy allocation as ``pos`` crosses a
+  block boundary), ``block_cow`` (copy-on-write duplication before a
+  write into a shared block), ``preempt``/``resume`` (swap-out under
+  pool pressure and later re-admission) — and a ``preempted`` span on
+  the request track bridging the swap gap, so a preempted request's
+  waterfall shows exactly where its wall time went while its blocks
+  were lent out.
+
 Tracks: every record resolves to a named track at append time — a slot
 track (``slot N``), a request track (``request <id>``), or a named
 component track (``scheduler``, ``predict``, ``kvpool``, ``http``). The

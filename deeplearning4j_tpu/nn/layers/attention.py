@@ -19,6 +19,14 @@ prefill, inference/engine.py): a chunk's K/V rows land at [pos, pos+T)
 via per-row offset `dynamic_update_slice`, RoPE rotates at each row's
 absolute positions, and the causal mask covers both the cache depth AND
 query order within the chunk (`_grouped_attention` qpos0).
+
+Two cache layouts share that step contract: the original contiguous
+per-slot stripe ({"k", "v", "pos"}), and the paged layout
+({"k_pages", "v_pages", "pos"} + an injected block ``table`` —
+inference/kvpool.py, `_paged_step`) where K/V rows live in pool-wide
+fixed-size pages and a slot's capacity is bounded by pool bytes instead
+of ``max_cache_len``. Both run the same `_grouped_attention` math, so
+they are token-identical.
 """
 from __future__ import annotations
 
@@ -205,6 +213,8 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
                 "non-causal layer's full forward attends to FUTURE "
                 "positions the cache cannot know yet (same limitation as "
                 "bidirectional LSTM rnnTimeStep)")
+        if "k_pages" in state0:
+            return self._paged_step(params, x, state0, mask=mask)
         B, T, _ = x.shape
         pos = state0["pos"]
         L_cap = state0["k"].shape[1]
@@ -248,3 +258,71 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         next_pos = jnp.where(overflow, jnp.asarray(L_cap + 1, jnp.int32),
                              pos + T)
         return y, {"k": kc, "v": vc, "pos": next_pos}
+
+    def _paged_step(self, params, x, state0, *, mask=None):
+        """Paged-KV inference step (inference/kvpool.py, the ISSUE 6
+        layout): K/V rows live in pool-wide page arrays
+        (``k_pages``/``v_pages``: [pages, block, Hkv, Dh], page 0 the
+        scratch row) instead of a per-slot contiguous stripe, and each
+        batch row reaches its rows through an int32 block ``table``
+        ([B, nb]: logical block index -> page). The write at absolute
+        position p lands in ``pages[table[b, p//block], p % block]``;
+        the read gathers the row's whole table back into logical order —
+        positions [0, nb*block) — and runs the SAME grouped attention as
+        the contiguous step (identical math, so paged decode is
+        token-identical to contiguous decode).
+
+        ``wmask`` ([B, T] bool, optional): rows whose write must NOT
+        land (decode-masked idle/mid-prefill slots, padded prefill-chunk
+        lanes) are redirected to the scratch page — without this, a
+        frozen slot's garbage write would corrupt a possibly SHARED
+        block at its own frontier. The scheduler guarantees every block
+        a *real* write touches is allocated and exclusively owned
+        (copy-on-write happens host-side, before dispatch).
+
+        ``table``/``wmask`` are injected per call by the engine and not
+        returned (the table is host-authoritative; device state carries
+        only pages + pos)."""
+        B, T, _ = x.shape
+        pos = state0["pos"]          # [B] int32 (per-slot decode depths)
+        table = state0["table"]      # [B, nb] int32, padded with page 0
+        kp, vp = state0["k_pages"], state0["v_pages"]
+        Bk = kp.shape[1]
+        nb = table.shape[1]
+        L = nb * Bk
+        wmask = state0.get("wmask")
+        overflow = (pos + T) > L
+        q, k_new, v_new = self._qkv(params, x, pos0=pos)
+        p = pos[:, None] + jnp.arange(T, dtype=pos.dtype)[None, :]  # [B, T]
+        blk = jnp.take_along_axis(table, jnp.minimum(p // Bk, nb - 1),
+                                  axis=1)
+        if wmask is not None:
+            blk = jnp.where(wmask, blk, 0)  # masked lanes -> scratch page
+            # ...and ZERO their values: a masked row deeper than this
+            # step's table bucket is output-poisoned (overflow NaN), and
+            # the next layer's K/V projection of that NaN would land in
+            # the scratch page — where `softmax_prob(0) * NaN = NaN`
+            # leaks through every later reader's attention einsum even
+            # on causally-masked lanes. Pages must only ever hold
+            # finite rows.
+            keep = wmask[..., None, None]
+            k_new = jnp.where(keep, k_new, 0)
+            v_new = jnp.where(keep, v_new, 0)
+        blk = jnp.where(p // Bk < nb, blk, 0)  # beyond-table -> scratch
+        off = p % Bk
+        kp2 = kp.at[blk, off].set(k_new)
+        vp2 = vp.at[blk, off].set(v_new)
+        kc = kp2[table].reshape(B, L, kp.shape[2], kp.shape[3])
+        vc = vp2[table].reshape(B, L, vp.shape[2], vp.shape[3])
+        o = self._grouped_attention(q, kc, vc, causal=True, qpos0=pos)
+        if mask is not None:
+            o = o * mask[:, :, None, None].astype(o.dtype)
+        y = self._out(params, o, B, T)
+        y = jnp.where(overflow[:, None, None],
+                      jnp.asarray(jnp.nan, y.dtype), y)
+        # the overflow sentinel must out-range EVERY table bucket the
+        # scheduler may present later (bucket widths vary per step), so
+        # it is an absolute huge position, not this bucket's cap+1
+        next_pos = jnp.where(overflow, jnp.asarray(1 << 30, jnp.int32),
+                             pos + T)
+        return y, {"k_pages": kp2, "v_pages": vp2, "pos": next_pos}

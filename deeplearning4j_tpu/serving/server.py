@@ -20,16 +20,22 @@ Generative serving: pass ``decode_vocab`` (the LM's vocabulary size) and
 the server additionally runs a `inference.DecodeScheduler` — slot-based
 continuous-batching decode with chunked prefill — behind `POST /generate`.
 ``prefill_chunk`` is the TTFT / decode-latency knob (`dl4j-tpu serve
---generate --prefill-chunk C`); ``prefix_cache_mb``/``kv_block``
-(`--prefix-cache-mb MB --kv-block B`) enable the block-pooled prefix KV
-cache (`inference/kvpool.py`) so repeated prompt prefixes restore from
-cached blocks instead of re-prefilling. The scheduler's metrics (TTFT,
-prefill tokens, chunk sizes, prefix hit rate, cancellations) land in the
-same registry as the request-path metrics, so `GET /metrics` and the UI
-`/serving` page show the whole hot path. Requests that cannot fit the KV
-cache (`len(prompt) + max_new_tokens - 1 > max_cache_len`) are rejected
+--generate --prefill-chunk C`). ``kv_pool_mb``/``kv_block``
+(`--kv-pool-mb MB --kv-block B`) switch the decode cache to the PAGED
+layout (`inference/kvpool.py`): all slots share one block pool, so slot
+capacity is bounded by pool bytes instead of ``slots × max_cache_len``,
+prompt prefixes restore as zero-copy block-table remaps, and cold slots
+are preempted-and-resumed under pool pressure. ``prefix_cache_mb``
+(`--prefix-cache-mb MB`) is the contiguous-mode side prefix cache,
+ignored when the paged pool is on. The scheduler's metrics (TTFT,
+prefill tokens, chunk sizes, prefix hit rate, pool occupancy,
+preemptions, cancellations) land in the same registry as the
+request-path metrics, so `GET /metrics` and the UI `/serving` page show
+the whole hot path. Requests that cannot fit the KV cache are rejected
 up front with HTTP 413 (counted in `decode_rejected_total`) instead of
-dying mid-decode on the attention layer's overflow guard.
+dying mid-decode on the attention layer's overflow guard — contiguous
+mode bounds on ``max_cache_len``, paged mode only on the WHOLE pool
+(the 413 body then reports ``blocks_needed`` vs ``blocks_available``).
 
 Observability (`inference/trace.py`): the server owns a span flight
 recorder written from the HTTP layer, batcher, decode scheduler, and KV
@@ -102,6 +108,7 @@ class InferenceServer:
                  decode_vocab: Optional[int] = None, decode_slots: int = 4,
                  prefill_chunk: int = 64, decode_queue: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
+                 kv_pool_mb: float = 0.0,
                  metrics: Optional[MetricsRegistry] = None,
                  trace_buffer: int = 8192,
                  tracer: Optional[FlightRecorder] = None):
@@ -124,6 +131,7 @@ class InferenceServer:
         self.decode_queue = int(decode_queue)
         self.prefix_cache_mb = float(prefix_cache_mb)
         self.kv_block = int(kv_block)
+        self.kv_pool_mb = float(kv_pool_mb)
         self._decoder: Optional[DecodeScheduler] = None
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         # per-server flight recorder (like the per-server MetricsRegistry:
@@ -230,6 +238,7 @@ class InferenceServer:
                 prefill_chunk=self.prefill_chunk,
                 prefix_cache_mb=self.prefix_cache_mb,
                 kv_block=self.kv_block,
+                kv_pool_mb=self.kv_pool_mb,
                 metrics=self.metrics, tracer=self.tracer).start()
         m_http = self.metrics.counter("http_requests_total")
         m_err = self.metrics.counter("http_errors_total")
@@ -338,10 +347,16 @@ class InferenceServer:
                     # KV cache BEFORE queueing (no slot ever admitted a
                     # request destined to die on the overflow guard);
                     # 413 tells the client the payload itself is the
-                    # problem, unlike a retryable 503/504
+                    # problem, unlike a retryable 503/504. Paged engines
+                    # reject on POOL capacity (the whole budget, not a
+                    # per-slot stripe) and the body carries the math
+                    body = {"error": f"prompt too long: {e}",
+                            "request_id": rid}
+                    if getattr(e, "blocks_needed", None) is not None:
+                        body["blocks_needed"] = e.blocks_needed
+                        body["blocks_available"] = e.blocks_available
                     m_err.inc()
-                    self._send({"error": f"prompt too long: {e}",
-                                "request_id": rid}, 413, request_id=rid)
+                    self._send(body, 413, request_id=rid)
                 except TimeoutError as e:  # incl. RequestTimeoutError and
                     # decode-scheduler timeouts (the decode is cancelled
                     # by generate() before the error propagates here)

@@ -281,6 +281,7 @@ _SERVING_PAGE = """<!DOCTYPE html>
 <h2>Serving SLO metrics</h2>
 <div id="meta"></div>
 <div id="decode" style="color:#555"></div>
+<div id="kvpool" style="color:#555"></div>
 <div id="trace" style="font-family:monospace;font-size:12px"></div>
 <table id="t" border="1" cellpadding="4" style="border-collapse:collapse">
 </table>
@@ -340,6 +341,18 @@ async function refresh() {
         : '') +
       (c.decode_cancelled_total ? ', ' + c.decode_cancelled_total +
         ' cancelled' : '');
+  const g = m.gauges || {};
+  if (g.kv_pool_blocks_capacity)  // paged KV pool occupancy line
+    document.getElementById('kvpool').innerText =
+      'kv pool: ' + (g.kv_pool_blocks_live ?
+        g.kv_pool_blocks_live.value : 0) + ' live / ' +
+      (g.kv_pool_blocks_free ? g.kv_pool_blocks_free.value : 0) +
+      ' free of ' + g.kv_pool_blocks_capacity.value + ' blocks (' +
+      (100 * (r.kv_pool_utilization || 0)).toFixed(1) + '% used' +
+      ', peak ' + (g.kv_pool_blocks_live ?
+        g.kv_pool_blocks_live.max : 0) + ')' +
+      (c.decode_preempted_total ? ', ' + c.decode_preempted_total +
+        ' preempted' : '');
   let rows = '<tr><th>metric</th><th>value</th></tr>';
   for (const [k, v] of Object.entries(m.counters || {}))
     rows += '<tr><td>' + k + '</td><td>' + v + '</td></tr>';

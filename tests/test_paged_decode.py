@@ -1,0 +1,449 @@
+"""Paged KV decode: block tables over the unified pool (ISSUE 6).
+
+The acceptance contract: with ``kv_pool_mb`` set, the live decode cache
+is the block pool itself — per-slot block tables over pool-wide pages —
+and paged decode is TOKEN-IDENTICAL to contiguous decode and solo
+decoding (greedy, seeded-sampled, and the LSTM fallback path) under
+``transfer_guard="disallow"``. Prefix restore on a full-block hit is a
+zero-copy block-table remap (no gather program exists in paged mode; the
+only device work is one pos write), a full-prompt hit's one-token refeed
+copy-on-writes the shared tail block without corrupting the cached
+original, preempt-and-resume under pool pressure loses no tokens,
+admission is pool-bytes-based (a prompt longer than ``max_cache_len``
+decodes fine; one bigger than the whole pool is 413 with the block
+math in the body), tiny-pool eviction interleaving stays correct, and
+the paged program families hold their CompileCounter budgets (block
+tables are padded to pow2 bucket widths — no per-length recompiles).
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import CompileCounter
+from deeplearning4j_tpu.inference import (DecodeScheduler, MetricsRegistry,
+                                          PromptTooLongError)
+from deeplearning4j_tpu.inference.kvpool import SCRATCH_BLOCK
+from deeplearning4j_tpu.inference.trace import FlightRecorder
+from deeplearning4j_tpu.models.sampling import generate_transformer
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+V = 13
+
+
+def _lm(cache=96):
+    conf = transformer_lm(vocab_size=V, d_model=16, n_heads=2, n_blocks=2,
+                          rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = cache
+    return ComputationGraph(conf).init()
+
+
+# bytes per (k+v, 2-layer, Hkv=2, Dh=8, f32) block of B positions: B * 256
+def _pool_mb(blocks, block):
+    """MiB budget buying exactly ``blocks`` usable blocks (+1 scratch)."""
+    return (blocks + 1) * block * 256 / float(1 << 20)
+
+
+# --------------------------------------------------------- token identity --
+def test_paged_greedy_token_identical_to_contiguous_and_solo():
+    """Mixed prompt lengths across concurrent slots, paged vs contiguous
+    vs solo — all token-identical, under the device-residency audit (the
+    block table ships as an explicit jnp.asarray-of-ndarray transfer)."""
+    net = _lm(cache=96)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, V, n)) for n in (7, 23, 40, 61)]
+    solo = [generate_transformer(net, p, 6, V, use_cache=True)
+            for p in prompts]
+    cont = DecodeScheduler(net, V, n_slots=4, prefill_chunk=16,
+                           metrics=MetricsRegistry(),
+                           transfer_guard="disallow").start()
+    try:
+        cont_out = [h.result(120) for h in
+                    [cont.submit(p, 6) for p in prompts]]
+    finally:
+        cont.stop()
+    paged = DecodeScheduler(net, V, n_slots=4, prefill_chunk=16,
+                            kv_pool_mb=_pool_mb(32, 8), kv_block=8,
+                            metrics=MetricsRegistry(),
+                            transfer_guard="disallow").start()
+    try:
+        assert paged.paged and paged.pool.capacity_blocks == 32
+        paged_out = [h.result(120) for h in
+                     [paged.submit(p, 6) for p in prompts]]
+    finally:
+        paged.stop()
+    assert cont_out == solo
+    assert paged_out == solo
+    assert paged.pool.outstanding_refs() == 0
+
+
+def test_paged_seeded_sampling_matches_solo_through_prefix_hit():
+    net = _lm(cache=96)
+    prompt = list(np.random.default_rng(1).integers(0, V, 40))
+    kw = dict(temperature=0.8, top_k=5, top_p=0.9, seed=11)
+    solo = generate_transformer(net, prompt, 6, V, use_cache=True, **kw)
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_pool_mb=_pool_mb(32, 8), kv_block=8,
+                          metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
+    try:
+        assert eng.generate(prompt, 6, timeout=120, **kw) == solo
+        assert eng.generate(prompt, 6, timeout=120, **kw) == solo  # hit
+    finally:
+        eng.stop()
+
+
+def test_lstm_fallback_warns_and_stays_token_identical():
+    """kv_pool_mb on a recurrent net (no position-addressed KV rows to
+    page) must fall back to contiguous state with a warning — and still
+    decode identically to a plain engine."""
+    from deeplearning4j_tpu.models.zoo import char_rnn_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    rnn = MultiLayerNetwork(char_rnn_lstm(vocab_size=V, hidden=8)).init()
+    with pytest.warns(RuntimeWarning, match="paged KV decode is DISABLED"):
+        eng = DecodeScheduler(rnn, V, n_slots=1, prefill_chunk=8,
+                              kv_pool_mb=2.0, metrics=MetricsRegistry())
+    assert not eng.paged and eng.pool is None
+    ref = DecodeScheduler(rnn, V, n_slots=1, prefill_chunk=8,
+                          metrics=MetricsRegistry()).start()
+    eng.start()
+    try:
+        p = [1, 2, 3, 4, 5]
+        assert eng.generate(p, 4, timeout=120) == \
+            ref.generate(p, 4, timeout=120)
+    finally:
+        eng.stop()
+        ref.stop()
+
+
+# --------------------------------------------- zero-copy restore and COW --
+def test_full_block_hit_is_zero_copy_remap_with_cow_refeed():
+    """A prompt of exactly N full blocks served repeatedly: the repeat
+    restores ALL N blocks by table remap (no gather/scatter program even
+    exists in paged mode), re-feeds only the last token, and that write
+    COWs the shared tail block — the cached original must stay intact
+    for the third request. Runs under transfer_guard: the remap is pure
+    host-side table surgery plus one explicit pos write."""
+    net = _lm(cache=96)
+    prompt = list(np.random.default_rng(2).integers(0, V, 32))  # 4 blocks
+    solo = generate_transformer(net, prompt, 5, V, use_cache=True)
+    m = MetricsRegistry()
+    tr = FlightRecorder(4096)
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_pool_mb=_pool_mb(32, 8), kv_block=8,
+                          metrics=m, tracer=tr,
+                          transfer_guard="disallow").start()
+    try:
+        assert eng.submit(prompt, 5).result(120) == solo  # cold: publish
+        assert eng.submit(prompt, 5).result(120) == solo  # remap + COW
+        assert eng.submit(prompt, 5).result(120) == solo  # cache intact
+    finally:
+        eng.stop()
+    # hit = full 4 blocks, capped one token short: 31 restored per repeat
+    assert m.counter("prefix_cache_hit_tokens_total").value == 62
+    names = [e["name"] for e in tr.events()]
+    assert names.count("block_cow") == 2  # one per warm repeat
+    # zero-copy assertion: no restore gather/publish scatter programs
+    assert eng._jrestore is None and eng._jpublish is None
+    remaps = [e for e in tr.events() if e["name"] == "prefix_restore"
+              and e["ph"] == "E" and e.get("args", {}).get("remap_blocks")]
+    assert remaps and all(e["args"]["kv_copies"] == 0 for e in remaps)
+
+
+def test_publish_is_ownership_transfer_not_copy():
+    """Finish hands the prompt's blocks to the trie in place: pool
+    occupancy must equal the adopted blocks (nothing double-allocated),
+    and a second engine pass restores from exactly those pages."""
+    net = _lm(cache=96)
+    prompt = list(np.random.default_rng(3).integers(0, V, 24))  # 3 blocks
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=1, prefill_chunk=16,
+                          kv_pool_mb=_pool_mb(16, 8), kv_block=8,
+                          metrics=m).start()
+    try:
+        eng.generate(prompt, 3, timeout=120)
+        # slot freed: only the adopted prompt blocks remain live
+        assert eng.pool.used_blocks == 3
+        assert eng.pool.match(prompt, 3)[0] == 3
+        n, ids, node = eng.pool.match(prompt, 3)
+        eng.pool.release(node)
+        eng.pool.release(node)  # drop the probe references
+        assert SCRATCH_BLOCK not in ids
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------ preempt / resume --
+def test_preempt_and_resume_mid_decode_is_token_identical():
+    """Two sequences whose decode growth exceeds the pool: the
+    latest-submitted slot is swapped out (blocks released, requeued) and
+    resumed after the first finishes — outputs identical to solo, swap
+    visible in metrics and trace instants."""
+    net = _lm(cache=96)
+    rng = np.random.default_rng(4)
+    p1, p2 = [list(rng.integers(0, V, 6)) for _ in range(2)]
+    solo1 = generate_transformer(net, p1, 10, V, use_cache=True)
+    solo2 = generate_transformer(net, p2, 10, V, use_cache=True)
+    m = MetricsRegistry()
+    tr = FlightRecorder(8192)
+    # each sequence needs ceil((6+10-1)/4) = 4 blocks; 7 cannot hold 8
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_pool_mb=_pool_mb(7, 4), kv_block=4,
+                          metrics=m, tracer=tr).start()
+    try:
+        h1 = eng.submit(p1, 10)
+        h2 = eng.submit(p2, 10)
+        assert h1.result(120) == solo1
+        assert h2.result(120) == solo2
+        assert eng.pool.outstanding_refs() == 0
+    finally:
+        eng.stop()
+    assert m.counter("decode_preempted_total").value >= 1
+    names = [e["name"] for e in tr.events()]
+    assert names.count("preempt") >= 1
+    assert names.count("resume") >= 1
+    # the swap gap is a span on the request track: every preempted B has
+    # a matching E (resume or cancel closed it)
+    pre = [e for e in tr.events() if e["name"] == "preempted"]
+    assert len([e for e in pre if e["ph"] == "B"]) == \
+        len([e for e in pre if e["ph"] == "E"]) >= 1
+
+
+def test_preempted_sampled_sequence_resumes_with_same_rng_stream():
+    """Token identity through a swap must hold for SAMPLED decoding too:
+    the resumed re-prefill recomputes K/V but never touches the
+    sequence's host RNG, so the draw order is unchanged."""
+    net = _lm(cache=96)
+    rng = np.random.default_rng(5)
+    p1, p2 = [list(rng.integers(0, V, 6)) for _ in range(2)]
+    kw = dict(temperature=0.9, top_k=6, seed=7)
+    solo2 = generate_transformer(net, p2, 10, V, use_cache=True, **kw)
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_pool_mb=_pool_mb(7, 4), kv_block=4,
+                          metrics=m).start()
+    try:
+        h1 = eng.submit(p1, 10)
+        h2 = eng.submit(p2, 10, **kw)  # admitted second -> the victim
+        h1.result(120)
+        assert h2.result(120) == solo2
+    finally:
+        eng.stop()
+    assert m.counter("decode_preempted_total").value >= 1
+
+
+# --------------------------------------------------- admission / eviction --
+def test_admission_is_pool_bytes_not_max_cache_len():
+    """The oversize-413 satellite: a prompt LONGER than max_cache_len
+    decodes fine when the pool holds it (no per-slot stripe to outgrow);
+    one bigger than the whole pool raises the typed error carrying the
+    block math."""
+    net = _lm(cache=32)  # conf cap far below the pool
+    prompt = list(np.random.default_rng(6).integers(0, V, 48))
+    solo = generate_transformer(net, prompt, 4, V, use_cache=False)
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=1, prefill_chunk=16,
+                          kv_pool_mb=_pool_mb(8, 8), kv_block=8,
+                          metrics=m).start()
+    try:
+        assert eng._cache_cap == 64  # pool positions, not max_cache_len
+        assert eng.generate(prompt, 4, timeout=120) == solo
+        with pytest.raises(PromptTooLongError, match="KV blocks") as ei:
+            eng.submit(list(np.random.default_rng(7).integers(0, V, 70)), 4)
+        assert ei.value.blocks_needed == 10
+        assert ei.value.blocks_available == 8
+        assert m.counter("decode_rejected_total").value == 1
+    finally:
+        eng.stop()
+
+
+def test_server_413_body_reports_blocks_needed_vs_available():
+    from deeplearning4j_tpu.serving import InferenceServer
+    net = _lm(cache=32)
+    srv = InferenceServer(net=net, decode_vocab=V, decode_slots=1,
+                          prefill_chunk=16, kv_block=16,
+                          kv_pool_mb=_pool_mb(4, 16)).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": [1] * 70,
+                             "max_new_tokens": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 413
+        body = json.loads(ei.value.read())
+        assert body["blocks_needed"] == 5 and body["blocks_available"] == 4
+        # a prompt beyond max_cache_len=32 but inside the pool SERVES
+        ok = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": [1] * 40,
+                             "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        assert len(json.loads(
+            urllib.request.urlopen(ok).read())["tokens"]) == 2
+    finally:
+        srv.stop()
+
+
+def test_tiny_pool_admission_eviction_interleaving_stays_correct():
+    """A stream of distinct prompts through a pool barely bigger than
+    one sequence: publishes evict earlier prefixes, admission gates on
+    reclaimable blocks, slots swap — every output must stay correct and
+    occupancy within capacity throughout."""
+    net = _lm(cache=96)
+    rng = np.random.default_rng(8)
+    prompts = [list(rng.integers(0, V, n)) for n in (20, 9, 26, 14)]
+    solos = [generate_transformer(net, p, 4, V, use_cache=True)
+             for p in prompts]
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_pool_mb=_pool_mb(9, 4), kv_block=4,
+                          metrics=m).start()
+    try:
+        for rep in range(2):
+            handles = [eng.submit(p, 4) for p in prompts]
+            for h, solo in zip(handles, solos):
+                assert h.result(120) == solo
+            assert eng.pool.used_blocks <= eng.pool.capacity_blocks
+        assert eng.pool.outstanding_refs() == 0
+    finally:
+        eng.stop()
+    assert m.counter("prefix_cache_evicted_blocks_total").value >= 1
+    assert m.gauge("kv_pool_blocks_live").max <= 9
+    snap = m.snapshot()
+    assert 0.0 <= snap["ratios"]["kv_pool_utilization"] <= 1.0
+
+
+# ------------------------------------------------------- compile budgets --
+def test_paged_program_families_hold_compile_budgets():
+    """Block tables are padded to pow2 bucket widths: a mixed workload
+    (lengths straddling table buckets, hits, COWs, preemptions) compiles
+    at most one decode program per table bucket, one prefill program per
+    (chunk, table) bucket pair, and exactly one setpos + one COW
+    program — never one per sequence length."""
+    net = _lm(cache=96)
+    rng = np.random.default_rng(9)
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_pool_mb=_pool_mb(16, 8), kv_block=8,
+                          metrics=m).start()
+    audit = CompileCounter.for_scheduler(eng)
+    base = list(rng.integers(0, V, 32))
+    try:
+        for p in [base, base, base[:16] + [1] * 5, [2, 3],
+                  list(rng.integers(0, V, 49)), base]:
+            eng.generate(p, 3, timeout=120)
+    finally:
+        eng.stop()
+    audit.assert_within_budget()
+    counts = audit.counts()
+    assert counts["decode"] >= 1
+    assert counts["restore_setpos"] == 1
+    assert counts["block_cow"] == 1  # the full-match refeed COW compiled
+    assert eng.table_buckets == [1, 2, 4, 8, 16]
+
+
+def test_paged_slot_release_returns_every_block():
+    """Every slot-freeing path (finish, cancel, stop) must return owned
+    blocks and the trie pin — the paged analogue of the ISSUE 4 refcount
+    leak tests."""
+    net = _lm(cache=96)
+    prompt = list(np.random.default_rng(10).integers(0, V, 24))
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=1, prefill_chunk=4,
+                          kv_pool_mb=_pool_mb(16, 8), kv_block=8,
+                          metrics=m).start()
+    try:
+        eng.generate(prompt, 2, timeout=120)  # publish 3 blocks
+        live_after_publish = eng.pool.used_blocks
+        long = prompt + list(np.random.default_rng(11).integers(0, V, 80))
+        h = eng.submit(long, 8)
+        import time as _t
+        deadline = _t.monotonic() + 30
+        while eng.pool.outstanding_refs() == 0:
+            assert _t.monotonic() < deadline, "restore never pinned"
+            _t.sleep(0.002)
+        h.cancel()
+        while eng.pool.outstanding_refs() != 0:
+            assert _t.monotonic() < deadline, "cancel leaked a pin"
+            _t.sleep(0.005)
+        deadline = _t.monotonic() + 30
+        while eng.pool.used_blocks != live_after_publish:
+            assert _t.monotonic() < deadline, "cancel leaked blocks"
+            _t.sleep(0.005)
+    finally:
+        eng.stop()
+    assert eng.pool.outstanding_refs() == 0
+    assert (eng._table == SCRATCH_BLOCK).all()
+
+
+def test_paged_pool_insert_syncs_gauges_not_used_bytes():
+    """insert() on a PAGED pool must update the kv_pool gauges, not the
+    contiguous-mode used-bytes gauge (which a paged pool never creates)
+    — a direct-API regression guard: the engine itself only adopt()s."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.inference.kvpool import KVPool
+    attn = {"a": {"k": jnp.zeros((1, 32, 2, 8)),
+                  "v": jnp.zeros((1, 32, 2, 8)),
+                  "pos": jnp.zeros((1,), jnp.int32)}}
+    m = MetricsRegistry()
+    pool = KVPool(attn, block=8, paged=True, metrics=m,
+                  budget_bytes=5 * 8 * 2 * (2 * 8) * 4)
+    assert pool.capacity_blocks == 4
+    start, ids = pool.insert(list(range(16)))
+    assert start == 0 and len(ids) == 2
+    assert m.gauge("kv_pool_blocks_live").value == 2
+    assert m.gauge("kv_pool_blocks_free").value == 2
+
+
+def test_prefix_cache_survives_failed_paged_engagement():
+    """kv_pool_mb too small for even two blocks must not silently drop a
+    configured prefix_cache_mb: the contiguous side prefix pool engages
+    (the documented fallback), it is just not paged."""
+    net = _lm(cache=32)
+    with pytest.warns(RuntimeWarning, match="paged KV decode is DISABLED"):
+        eng = DecodeScheduler(net, V, n_slots=1, prefill_chunk=8,
+                              kv_pool_mb=1e-6, kv_block=8,
+                              prefix_cache_mb=_pool_mb(8, 8))
+    assert eng.paged is False
+    assert eng.pool is not None  # the contiguous prefix pool
+    assert eng.pool.capacity_blocks == 8
+    prompt = list(np.random.default_rng(12).integers(0, V, 20))
+    solo = generate_transformer(net, prompt, 3, V, use_cache=False)
+    eng.start()
+    try:
+        assert eng.generate(prompt, 3, timeout=120) == solo
+        assert eng.generate(prompt, 3, timeout=120) == solo  # via the hit
+    finally:
+        eng.stop()
+
+
+def test_full_pool_full_prompt_hit_converges_instead_of_livelocking():
+    """A block-aligned prompt whose published blocks fill the ENTIRE
+    pool, resubmitted: the full-hit refeed needs a COW page that can
+    never exist (every page backs this very prompt's pinned prefix).
+    The starved attempt must fall back to a one-block-short hit — not
+    spin preempt/restore forever."""
+    net = _lm(cache=96)
+    prompt = list(np.random.default_rng(13).integers(0, V, 32))  # 4 blocks
+    solo = generate_transformer(net, prompt, 1, V, use_cache=False)
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=1, prefill_chunk=8,
+                          kv_pool_mb=_pool_mb(4, 8), kv_block=8,
+                          metrics=m).start()
+    try:
+        assert eng.generate(prompt, 1, timeout=120) == solo  # publish 4/4
+        assert eng.pool.free_blocks == 0
+        assert eng.generate(prompt, 1, timeout=120) == solo  # the trap
+    finally:
+        eng.stop()
+    # exactly one starved preempt cycle, then the capped hit converges
+    assert m.counter("decode_preempted_total").value == 1

@@ -239,6 +239,53 @@ def test_cancelled_mid_prefill_span_tree_is_closed():
     _validate_chrome(rec.chrome_trace())
 
 
+def test_preempted_request_waterfall_shows_the_swap_gap():
+    """Paged-KV preemption (ISSUE 6): the swapped-out request's track
+    must carry a ``preempted`` span bridging preempt -> resume (the
+    visible swap gap), the slot tracks the ``block_alloc`` /
+    ``preempt`` / ``resume`` instants, and the Chrome export must stay
+    Perfetto-valid through the swap (every B paired, nesting intact)."""
+    V = 13
+    net = _lm(V, cache=96)
+    rec = FlightRecorder(8192)
+    m = MetricsRegistry()
+    rng = np.random.default_rng(2)
+    p1, p2 = [list(rng.integers(0, V, 6)) for _ in range(2)]
+    # 7 usable 4-position blocks; each sequence grows to 4 -> preempt.
+    # bytes/block = 2 layers * (k+v) * 4 pos * 2 heads * 8 dim * 4B
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_pool_mb=8 * 1024 / float(1 << 20), kv_block=4,
+                          metrics=m, tracer=rec).start()
+    try:
+        h1 = eng.submit(p1, 10)
+        h2 = eng.submit(p2, 10)
+        h1.result(120)
+        h2.result(120)
+    finally:
+        eng.stop()
+    assert m.counter("decode_preempted_total").value >= 1
+    evs = rec.events()
+    names = [e["name"] for e in evs]
+    assert "block_alloc" in names
+    assert "preempt" in names and "resume" in names
+    # the preempt instant carries the swap accounting
+    pre = [e for e in evs if e["name"] == "preempt"][0]
+    assert pre["args"]["blocks_released"] >= 1
+    assert "request" in pre["args"]
+    # the victim's request track: decode (or prefill) closed, then the
+    # preempted span opened and later closed by the resume
+    victim = pre["args"]["request"]
+    track = f"request {victim}"
+    rnames = [(e["ph"], e["name"]) for e in evs if e["track"] == track]
+    assert ("B", "preempted") in rnames and ("E", "preempted") in rnames
+    assert rnames.index(("B", "preempted")) < rnames.index(
+        ("E", "preempted"))
+    # resumed life: a SECOND prefill span after the swap gap
+    assert rnames.count(("B", "prefill")) >= 2
+    assert [n for n in rnames if n[0] == "i"][-1] == ("i", "finish")
+    _validate_chrome(rec.chrome_trace())
+
+
 # ------------------------------------------------------------ HTTP layer --
 def test_generate_response_carries_request_id_and_timings():
     V = 13
