@@ -29,6 +29,11 @@ Rules:
   JG006 host-sync-in-hot-loop  blocking device reads inside scheduler-loop
                                (thread-target) code outside the sanctioned
                                host_read() boundary
+  JG007 swallowed-exception-in-thread
+                               bare/overbroad except inside Thread-target
+                               call graphs that neither re-raises nor uses
+                               the caught exception — the bug class that
+                               hides scheduler-loop death
 """
 from __future__ import annotations
 
@@ -625,6 +630,45 @@ class ImpureInJit(_JaxRule):
         return out
 
 
+def _thread_target_functions(idx: _FnIndex
+                             ) -> List[Tuple[Optional[str], ast.AST]]:
+    """Thread-target functions plus everything they call in-module: the
+    code that runs on a dispatcher/scheduler thread's loop. Shared by
+    JG006 (host syncs stall the loop) and JG007 (swallowed exceptions
+    hide the loop's death)."""
+    seeds: Set[int] = set()
+    for cls, scope, call in idx._calls():
+        d = _dotted(call.func)
+        if not d or d.split(".")[-1] != "Thread":
+            continue
+        for kw in call.keywords:
+            if kw.arg == "target":
+                for target in idx._resolve(cls, scope, kw.value):
+                    seeds.add(id(target))
+    if not seeds:
+        return []
+    id2 = {}
+    for (cls, _), nodes in idx.defs.items():
+        for n in nodes:
+            id2[id(n)] = (cls, n)
+    hot = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        for nid in list(hot):
+            if nid not in id2:
+                continue
+            cls, node = id2[nid]
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                for target in idx._resolve(cls, node, call.func):
+                    if id(target) not in hot:
+                        hot.add(id(target))
+                        changed = True
+    return [id2[n] for n in hot if n in id2]
+
+
 class HostSyncInHotLoop(_JaxRule):
     id = "JG006"
     name = "host-sync-in-hot-loop"
@@ -636,46 +680,10 @@ class HostSyncInHotLoop(_JaxRule):
     # it is not in any sync pattern below, so routing a read through it
     # is exactly what clears the finding
 
-    def _hot_functions(self, mod: ModuleInfo, idx: _FnIndex
-                       ) -> List[Tuple[Optional[str], ast.AST]]:
-        """Thread-target functions plus everything they call in-module:
-        the code that runs on a dispatcher/scheduler thread's loop."""
-        seeds: Set[int] = set()
-        for cls, scope, call in idx._calls():
-            d = _dotted(call.func)
-            if not d or d.split(".")[-1] != "Thread":
-                continue
-            for kw in call.keywords:
-                if kw.arg == "target":
-                    for target in idx._resolve(cls, scope, kw.value):
-                        seeds.add(id(target))
-        if not seeds:
-            return []
-        id2 = {}
-        for (cls, _), nodes in idx.defs.items():
-            for n in nodes:
-                id2[id(n)] = (cls, n)
-        hot = set(seeds)
-        changed = True
-        while changed:
-            changed = False
-            for nid in list(hot):
-                if nid not in id2:
-                    continue
-                cls, node = id2[nid]
-                for call in ast.walk(node):
-                    if not isinstance(call, ast.Call):
-                        continue
-                    for target in idx._resolve(cls, node, call.func):
-                        if id(target) not in hot:
-                            hot.add(id(target))
-                            changed = True
-        return [id2[n] for n in hot if n in id2]
-
     def check_module(self, mod: ModuleInfo) -> List[Finding]:
         idx = self.index(mod)
         out = []
-        for cls, fn in self._hot_functions(mod, idx):
+        for cls, fn in _thread_target_functions(idx):
             for node in _own_statements(fn):
                 if not isinstance(node, ast.Call):
                     continue
@@ -717,5 +725,59 @@ class HostSyncInHotLoop(_JaxRule):
         return out
 
 
+class SwallowedExceptionInThread(_JaxRule):
+    id = "JG007"
+    name = "swallowed-exception-in-thread"
+    description = ("bare/overbroad except swallowing exceptions inside "
+                   "Thread-target call graphs hides loop death: the "
+                   "thread keeps 'running' (or dies silently) while "
+                   "every in-flight request hangs")
+
+    # an exception is considered HANDLED (not swallowed) when the
+    # handler re-raises, or binds the exception and actually uses it
+    # (fails a future with it, records it, wraps it); a handler that
+    # catches everything and uses nothing is the bug class that turned
+    # scheduler-loop death into silent request hangs
+    _BROAD = {"Exception", "BaseException"}
+
+    def _is_broad(self, type_node) -> bool:
+        if type_node is None:
+            return True  # bare `except:`
+        if isinstance(type_node, ast.Tuple):
+            return any(self._is_broad(e) for e in type_node.elts)
+        return _dotted(type_node).split(".")[-1] in self._BROAD
+
+    def _swallows(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return False  # re-raises (bare or wrapped)
+        if handler.name:
+            for node in ast.walk(handler):
+                if isinstance(node, ast.Name) and node.id == handler.name \
+                        and isinstance(node.ctx, ast.Load):
+                    return False  # the exception is consumed somewhere
+        return True
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        idx = self.index(mod)
+        out = []
+        for cls, fn in _thread_target_functions(idx):
+            for node in _own_statements(fn):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if self._is_broad(node.type) and self._swallows(node):
+                    what = ("bare 'except:'" if node.type is None else
+                            f"'except {_dotted(node.type) or '...'}'")
+                    out.append(mod.finding(
+                        self.id, node,
+                        f"{what} in Thread-target code swallows the "
+                        "exception without re-raising or recording it — "
+                        "a dying scheduler/dispatcher loop becomes a "
+                        "silent hang for every in-flight request; "
+                        "re-raise, fail the owning futures/handles with "
+                        "the error, or record it for a supervisor"))
+        return out
+
+
 RULES = [HostSyncInJit, TracerBranch, JitMutableGlobal, JitMissingStatics,
-         ImpureInJit, HostSyncInHotLoop]
+         ImpureInJit, HostSyncInHotLoop, SwallowedExceptionInThread]

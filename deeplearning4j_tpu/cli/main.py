@@ -16,6 +16,8 @@ Usage:
                    [--generate [--vocab-size V] [--decode-slots N]
                     [--prefill-chunk C] [--kv-pool-mb MB]
                     [--prefix-cache-mb MB] [--kv-block B]]
+                   [--no-supervise] [--hang-timeout S] [--retry-budget N]
+                   [--failpoint NAME=SPEC ...] [--failpoint-endpoint]
 """
 from __future__ import annotations
 
@@ -99,6 +101,8 @@ def cmd_serve(args) -> int:
 
     from ..serving import InferenceServer
 
+    from ..inference import failpoints
+
     kw = dict(port=args.port, max_batch=args.max_batch,
               batching=not args.no_batching,
               batch_window_ms=args.batch_window_ms,
@@ -109,7 +113,24 @@ def cmd_serve(args) -> int:
               prefix_cache_mb=args.prefix_cache_mb,
               kv_block=args.kv_block,
               kv_pool_mb=args.kv_pool_mb,
-              trace_buffer=args.trace_buffer)
+              trace_buffer=args.trace_buffer,
+              supervise=not args.no_supervise,
+              hang_timeout_s=args.hang_timeout,
+              retry_budget=args.retry_budget,
+              failpoint_endpoint=args.failpoint_endpoint)
+    # chaos seams: --failpoint flags, then the environment
+    # (DL4J_FAILPOINTS="name=spec;..."), both through the same parser
+    # so a typo'd seam or spec fails startup loudly
+    armed = []
+    for entry in args.failpoint or []:
+        name, sep, spec = entry.partition("=")
+        if not sep:
+            print(f"error: bad --failpoint {entry!r} (want name=spec)",
+                  file=sys.stderr)
+            return 2
+        failpoints.arm(name.strip(), spec.strip())
+        armed.append(name.strip())
+    armed += failpoints.arm_from_env()
     if getattr(args, "int8", False):
         # artifact must carry calibration (nn/quantization.save_quantized);
         # weight quantization is rebuilt deterministically from the params
@@ -161,12 +182,18 @@ def cmd_serve(args) -> int:
         kv_mode = ", prefix cache OFF"
     gen_mode = (f"; /generate: {args.decode_slots} slots, "
                 f"prefill chunk {args.prefill_chunk}" + kv_mode
+                + (f", supervised (hang timeout {args.hang_timeout}s, "
+                   f"retry budget {args.retry_budget})"
+                   if not args.no_supervise else ", UNSUPERVISED")
                 if args.generate else "")
-    print(f"Serving {args.model} ({mode}, {batch_mode}{gen_mode}) on "
-          f"http://127.0.0.1:{server.port} "
+    chaos = (f"; failpoints ARMED: {', '.join(armed)}" if armed else "")
+    print(f"Serving {args.model} ({mode}, {batch_mode}{gen_mode}{chaos}) "
+          f"on http://127.0.0.1:{server.port} "
           "(POST /predict, /predict/csv"
           + (", /generate" if args.generate else "")
-          + "; GET /health, /info, /metrics"
+          + (", /admin/drain" if args.generate and not args.no_supervise
+             else "")
+          + "; GET /health, /healthz, /readyz, /info, /metrics"
           + (f", /trace[{args.trace_buffer} events]"
              if args.trace_buffer else "") + ")")
     if args.once:  # test hook: start, report, stop
@@ -270,6 +297,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="span flight-recorder ring capacity (events) "
                         "backing GET /trace and per-request timings; "
                         "0 disables request-lifecycle tracing")
+    s.add_argument("--no-supervise", action="store_true",
+                   help="run the decode engine WITHOUT the crash-"
+                        "recovery supervisor (no watchdog, no engine "
+                        "restarts, no /readyz gating, no /admin/drain)")
+    s.add_argument("--hang-timeout", type=float, default=5.0,
+                   help="watchdog heartbeat staleness (seconds) that "
+                        "declares the scheduler loop hung and triggers "
+                        "an engine restart; set well above your "
+                        "model's worst single-iteration time")
+    s.add_argument("--retry-budget", type=int, default=3,
+                   help="submissions allowed per request across engine "
+                        "crashes before it fails with a structured 503")
+    s.add_argument("--failpoint", action="append", metavar="NAME=SPEC",
+                   help="arm a chaos seam, e.g. "
+                        "dispatch.decode=crash@n:3 or "
+                        "scheduler.iteration=hang:500@p:0.01:42 "
+                        "(repeatable; see inference/failpoints.py)")
+    s.add_argument("--failpoint-endpoint", action="store_true",
+                   help="TEST ONLY: expose POST /admin/failpoints so "
+                        "clients can arm/disarm chaos seams over HTTP")
     s.add_argument("--once", action="store_true",
                    help="start and immediately stop (smoke test)")
     s.set_defaults(func=cmd_serve)

@@ -17,15 +17,23 @@ Perfetto-loadable Chrome trace-event format).
 """
 from .batcher import (InferenceFuture, MicroBatcher, QueueFullError,
                       RequestTimeoutError, bucket_for, pow2_buckets)
-from .engine import DecodeHandle, DecodeScheduler, PromptTooLongError
+from .engine import (DecodeHandle, DecodeScheduler, EngineCrashedError,
+                     LoadSheddedError, PromptTooLongError)
+from .failpoints import (InjectedCrash, InjectedFault, InjectedHang,
+                         InjectedOOM)
 from .kvpool import KVPool
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry)
+from .supervisor import (AdmissionRejectedError, EngineSupervisor,
+                         RetryBudgetExceededError, ShuttingDownError)
 from .trace import FlightRecorder, default_recorder, new_request_id
 
-__all__ = ["Counter", "DecodeHandle", "DecodeScheduler", "FlightRecorder",
-           "Gauge", "Histogram", "InferenceFuture", "KVPool",
-           "MetricsRegistry", "MicroBatcher", "PromptTooLongError",
-           "QueueFullError", "RequestTimeoutError", "bucket_for",
+__all__ = ["AdmissionRejectedError", "Counter", "DecodeHandle",
+           "DecodeScheduler", "EngineCrashedError", "EngineSupervisor",
+           "FlightRecorder", "Gauge", "Histogram", "InferenceFuture",
+           "InjectedCrash", "InjectedFault", "InjectedHang", "InjectedOOM",
+           "KVPool", "LoadSheddedError", "MetricsRegistry", "MicroBatcher",
+           "PromptTooLongError", "QueueFullError", "RequestTimeoutError",
+           "RetryBudgetExceededError", "ShuttingDownError", "bucket_for",
            "default_recorder", "default_registry", "new_request_id",
            "pow2_buckets"]

@@ -32,6 +32,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from ..analysis.runtime import host_read
+from . import failpoints
 from .metrics import MetricsRegistry, default_registry
 from .trace import FlightRecorder, default_recorder
 
@@ -272,6 +273,7 @@ class MicroBatcher:
                     args={"requests": len(live),
                           "rows": sum(r.x.shape[0] for r in live)})
             try:
+                failpoints.fire("batcher.flush")  # chaos seam
                 outs = self._dispatch([r.x for r in live])
             except Exception as e:  # model failure fails the REQUESTS,
                 for req in live:    # never the dispatcher thread

@@ -101,6 +101,7 @@ from ..models.sampling import sample_logits
 from ..nn.layers.recurrent import (BaseRecurrentImpl,
                                    _materialize_rnn_states)
 from ..nn.multilayer import _compute_dtype_of
+from . import failpoints
 from .batcher import QueueFullError, bucket_for, pow2_buckets
 from .kvpool import SCRATCH_BLOCK, KVPool, gather_blocks, scatter_blocks
 from .metrics import MetricsRegistry, default_registry
@@ -110,6 +111,11 @@ from .trace import FlightRecorder, default_recorder, new_request_id
 # small program instead of compiling a 3-wide one-off); buckets smaller
 # than 16 only exist when prefill_chunk itself is smaller
 _MIN_CHUNK_BUCKET = 16
+
+
+class _EngineFenced(Exception):
+    """Internal: a fenced (supervisor-disowned) scheduler thread woke up
+    mid-iteration; unwind out of the loop without touching handles."""
 
 
 class PromptTooLongError(ValueError):
@@ -127,14 +133,31 @@ class PromptTooLongError(ValueError):
     blocks_available: Optional[int] = None
 
 
+class LoadSheddedError(QueueFullError):
+    """The request was dropped from the queue by the graceful-degradation
+    ladder (`inference/supervisor.py` level >= 1: queued load below the
+    surviving priority line is shed before the engine melts). A
+    QueueFullError subclass so the serving layer's existing 503 mapping
+    (retryable, not a client error) applies unchanged."""
+
+
+class EngineCrashedError(RuntimeError):
+    """The scheduler loop died (uncaught exception or injected fault)
+    with this request in flight and no supervisor attached to recover
+    it. Supervised engines never surface this — the supervisor requeues
+    the request onto the rebuilt engine instead."""
+
+
 class DecodeHandle:
     """Completion handle for one submitted generation request."""
 
     def __init__(self, prompt_len: int, max_new_tokens: int,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None, priority: int = 0):
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
         self.request_id = request_id or new_request_id()
+        self.priority = int(priority)
+        self.retries = 0  # crash-recovery resubmissions (supervisor)
         self.tokens: List[int] = []
         self._done = threading.Event()
         self._cancel = threading.Event()
@@ -175,9 +198,32 @@ class DecodeHandle:
         }
 
     def _finish(self, err: Optional[BaseException] = None) -> None:
+        if self._done.is_set():
+            return  # first finisher wins (supervisor shutdown can race
+            # the engine's own teardown sweep over the same handle)
         self._error = err
         self.t_done = time.monotonic()
         self._done.set()
+
+    def _reset_for_retry(self) -> None:
+        """Crash recovery (`inference/supervisor.py`): wipe the partial
+        progress so a resubmission re-runs the request from scratch on
+        the rebuilt engine. Decode is deterministic per request — the
+        resubmitted `_ActiveSeq` reseeds `default_rng(seed)` — so the
+        re-run reproduces the SAME token sequence the crashed attempt
+        was mid-way through (token-identity across restarts). t_submit
+        survives: recovered-request latency is measured from the
+        ORIGINAL submit, crash included."""
+        assert not self._done.is_set(), \
+            "cannot retry a handle that already finished"
+        self.retries += 1
+        self.tokens = []
+        self._error = None
+        self.t_admitted = None
+        self.t_restored = None
+        self.t_first_token = None
+        self.t_done = None
+        self.steps_to_first_token = None
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -350,6 +396,31 @@ class DecodeScheduler:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._transfer_guard = transfer_guard
+        # -- fault-tolerance surface (inference/supervisor.py) --
+        # heartbeat: stamped once per loop pass (idle passes included —
+        # the idle wait wakes every 0.1s), so a watchdog distinguishes
+        # "quiet" from "stuck" by staleness alone. Plain float store:
+        # atomic under the GIL, torn-read-free.
+        self.heartbeat = time.monotonic()
+        self.iterations = 0  # loop passes completed (watchdog progress)
+        # set (with the exception) when the loop dies instead of the old
+        # behavior — a daemon thread evaporating and every in-flight
+        # handle blocking out its full timeout in silence
+        self.crashed: Optional[BaseException] = None
+        # fence(): a supervisor that declared this engine dead sets the
+        # fence BEFORE requeueing its in-flight work elsewhere; a hung
+        # loop thread that later wakes sees the fence at its next
+        # iteration boundary (and _consume guards it) and exits without
+        # touching handles the replacement engine now owns
+        self._fenced = False
+        # supervisor crash hook: called (with the exception) from the
+        # dying loop thread. When None, the engine self-cleans: every
+        # in-flight/queued handle fails fast with EngineCrashedError
+        self._on_crash = None
+        # degradation ladder level >= 2 caps prefill chunks (the pow2
+        # family already contains every smaller bucket — changing the
+        # cap compiles nothing new)
+        self.chunk_cap: Optional[int] = None
         if self.prefill_chunk > 1:
             lo = min(_MIN_CHUNK_BUCKET, self.prefill_chunk)
             self.prefill_buckets = [b for b in pow2_buckets(self.prefill_chunk)
@@ -805,7 +876,14 @@ class DecodeScheduler:
         (0, 0) when no bucket fits the KV-cache headroom (the tail then
         prefills token-by-token through the decode step)."""
         remaining = len(seq.prompt) - seq.fed
-        n_real = min(remaining, self.prefill_chunk)
+        cap = self.prefill_chunk
+        if self.chunk_cap:
+            # degradation ladder (supervisor level >= 2): smaller chunks
+            # shorten each iteration's device hold, trading TTFT for
+            # decode tail latency under pressure. Smaller buckets are
+            # already in the compiled family — no new programs.
+            cap = max(1, min(cap, int(self.chunk_cap)))
+        n_real = min(remaining, cap)
         bucket = bucket_for(n_real, self.prefill_buckets)
         if self._cache_cap is not None and \
                 seq.fed + bucket > self._cache_cap:
@@ -1160,8 +1238,17 @@ class DecodeScheduler:
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None, seed: int = 0,
                eos_id: Optional[int] = None,
-               request_id: Optional[str] = None) -> DecodeHandle:
-        rid = request_id or new_request_id()
+               request_id: Optional[str] = None, priority: int = 0,
+               _handle: Optional[DecodeHandle] = None,
+               _front: bool = False) -> DecodeHandle:
+        """``priority``: degradation-ladder shedding order (higher
+        survives longer; default 0). ``_handle``/``_front``: the
+        supervisor's crash-recovery resubmission path — reuse the
+        ORIGINAL (reset) handle so the caller blocked in ``result()``
+        never notices the restart, and front-queue recovered work so it
+        does not wait behind requests submitted after the crash."""
+        rid = _handle.request_id if _handle is not None \
+            else (request_id or new_request_id())
         if not len(prompt_ids):
             raise ValueError("prompt_ids must be non-empty")
         if max_new_tokens < 1:
@@ -1209,8 +1296,9 @@ class DecodeScheduler:
                     f"prompt ({len(prompt_ids)}) + max_new_tokens "
                     f"({max_new_tokens}) needs a KV cache of {needed} but "
                     f"max_cache_len={self._cache_cap}")
-        handle = DecodeHandle(len(prompt_ids), max_new_tokens,
-                              request_id=rid)
+        handle = _handle if _handle is not None else DecodeHandle(
+            len(prompt_ids), max_new_tokens, request_id=rid,
+            priority=priority)
         seq = _ActiveSeq(handle, prompt_ids, temperature, top_k, top_p,
                          seed, eos_id)
         with self._cond:
@@ -1223,7 +1311,10 @@ class DecodeScheduler:
                     "waiting": len(self._queue)})
                 raise QueueFullError(
                     f"decode queue full ({self.max_queue} waiting)")
-            self._queue.append(seq)
+            if _front:
+                self._queue.insert(0, seq)
+            else:
+                self._queue.append(seq)
             self._m_queue_depth.set(len(self._queue))
             # the request's first span opens while the queue lock is
             # still held — the scheduler needs _cond to pop this seq, so
@@ -1271,6 +1362,23 @@ class DecodeScheduler:
         return self
 
     def stop(self) -> None:
+        if self._fenced:
+            # a fenced engine's handles are DISOWNED (the supervisor
+            # requeued them onto a replacement): finishing them here
+            # would fail requests another engine is actively serving.
+            # Just drop the references; the stuck thread (if any) exits
+            # at its next fence check.
+            with self._cond:
+                self._running = False
+                self._queue.clear()
+                self._cond.notify_all()
+            if self._thread is not None:
+                self._thread.join(timeout=1)
+                self._thread = None
+            # safe lock-free: the loop thread is joined (or, if it is a
+            # hung zombie, exits at its fence check without writing)
+            self._slots = [None] * self.n_slots  # graftlint: disable=CC004
+            return
         with self._cond:
             self._running = False
             pending = self._queue[:]
@@ -1319,10 +1427,23 @@ class DecodeScheduler:
         tr = self.tracer
         if not tr.enabled:
             return
-        # seq.phase (not the handle timestamps) names the open span: a
-        # resumed sequence is back in "prefill" with t_first_token long
-        # stamped, and one cancelled while swapped out has "preempted"
-        # open instead of "queued"
+        self._close_phase_span(seq)
+        tr.instant(outcome, req=rid,
+                   args={"request_id": rid, "tokens": len(h.tokens),
+                         **({"retries": h.retries} if h.retries else {}),
+                         **h.timings()})
+        if slot is not None:
+            tr.instant("free", track=self._slot_tracks[slot],
+                       args={"request": rid})
+
+    def _close_phase_span(self, seq: _ActiveSeq) -> None:
+        """End whichever request-track span is open. seq.phase (not the
+        handle timestamps) names it: a resumed sequence is back in
+        "prefill" with t_first_token long stamped, and one cancelled
+        while swapped out has "preempted" open instead of "queued"."""
+        h = seq.handle
+        rid = h.request_id
+        tr = self.tracer
         if seq.phase == "queued":
             tr.end("queued", req=rid)
         elif seq.phase == "prefill":
@@ -1332,12 +1453,6 @@ class DecodeScheduler:
         else:
             tr.end("decode", req=rid,
                    args={"tokens": len(h.tokens), "iterations": seq.steps})
-        tr.instant(outcome, req=rid,
-                   args={"request_id": rid, "tokens": len(h.tokens),
-                         **h.timings()})
-        if slot is not None:
-            tr.instant("free", track=self._slot_tracks[slot],
-                       args={"request": rid})
 
     def _evict_cancelled(self) -> None:
         for i, seq in enumerate(self._slots):
@@ -1457,6 +1572,11 @@ class DecodeScheduler:
         yields the first output token). Token-count metrics are NOT
         updated here — the loop flushes one batched `inc(n)` per
         iteration instead of taking the counter lock once per token."""
+        if self._fenced:
+            # a fenced thread woke mid-iteration: this handle may
+            # already be requeued on the replacement engine — appending
+            # a token (or finishing) here would corrupt/duplicate it
+            raise _EngineFenced
         h = seq.handle
         tok = sample_logits(probs_row, seq.temperature, seq.top_k,
                             seq.rng, seq.top_p)
@@ -1518,6 +1638,7 @@ class DecodeScheduler:
                     continue  # seq itself was preempted for blocks
             ids = np.zeros((bucket,), np.int32)
             ids[:n_real] = seq.prompt[seq.fed:seq.fed + n_real]
+            failpoints.fire("dispatch.prefill")
             if self.tracer.enabled:  # keep tracing-off allocation-free
                 self.tracer.begin("prefill_chunk",
                                   track=self._slot_tracks[i],
@@ -1558,6 +1679,9 @@ class DecodeScheduler:
         token must reach the host to be fed back); everything else ships
         to device explicitly (`jnp.asarray` of ndarrays, `device_index`).
         Metric counters are flushed once per iteration, not per token."""
+        if self._fenced:
+            raise _EngineFenced
+        failpoints.fire("scheduler.iteration")
         self._evict_cancelled()
         self._admit()
         # single-writer: _slots is mutated only by this scheduler thread
@@ -1598,6 +1722,7 @@ class DecodeScheduler:
             for i, seq in fed:
                 ids[i] = seq.next_input()
                 live[i] = True
+            failpoints.fire("dispatch.decode")
             if self.tracer.enabled:  # keep tracing-off allocation-free
                 self.tracer.begin("decode_step", track=self._sched_track,
                                   args={"live_slots": len(fed)})
@@ -1647,16 +1772,210 @@ class DecodeScheduler:
 
     def _loop(self) -> None:
         while True:
+            self.heartbeat = time.monotonic()
             with self._cond:
                 if not self._running:
                     return  # stop() fails any still-active handles
             guard = (jax.transfer_guard(self._transfer_guard)
                      if self._transfer_guard else contextlib.nullcontext())
-            with guard:
-                stepped = self._step_once()
+            try:
+                with guard:
+                    stepped = self._step_once()
+            except _EngineFenced:
+                return  # a supervisor already disowned this engine
+            except Exception as e:
+                # loop death used to be SILENT: the daemon thread
+                # evaporated, the HTTP tier kept admitting, and every
+                # in-flight caller blocked out its full timeout. Now the
+                # crash is recorded (self.crashed), traced, and either
+                # handed to the supervisor (which requeues the in-flight
+                # work onto a rebuilt engine) or failed fast
+                self._crash(e)
+                return
+            self.iterations += 1
             if not stepped:
                 with self._cond:
                     if not self._running:
                         return
                     if not self._queue:
                         self._cond.wait(timeout=0.1)
+
+    # -- crash / fence / degradation surface (inference/supervisor.py) ----
+    def _crash(self, exc: BaseException) -> None:
+        """Terminal bookkeeping on the dying loop thread. Supervised
+        (`_on_crash` set): handles stay OPEN — the supervisor owns them
+        now and will requeue each onto the rebuilt engine (their callers
+        never see the crash). Unsupervised: fail every in-flight and
+        queued handle fast with EngineCrashedError instead of leaving
+        the callers to block out their timeouts against a dead loop."""
+        if self._fenced:
+            return  # already declared dead and disowned; nothing to own
+        self.crashed = exc
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "engine_crash", track=self._sched_track,
+                args={"error": type(exc).__name__,
+                      "detail": str(exc)[:200],
+                      "iterations": self.iterations})
+        if self._on_crash is not None:
+            self._close_request_spans()
+            self._on_crash(exc)
+        else:
+            self._fail_all_inflight(EngineCrashedError(
+                f"decode engine crashed: {type(exc).__name__}: {exc}"))
+
+    def fence(self) -> None:
+        """Disown this engine: a supervisor that declared it dead (hung
+        heartbeat) fences it BEFORE requeueing its in-flight work onto a
+        replacement — if the stuck loop thread ever wakes, it sees the
+        fence at its next iteration boundary (and `_consume` refuses to
+        touch handles) and exits instead of double-finishing requests
+        the new engine now owns. The residual window — a thread awake
+        and past the fence checks at the exact fencing instant — is one
+        iteration wide; the supervisor additionally joins the thread
+        with a grace timeout before resubmitting."""
+        self._fenced = True
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+
+    def _fail_all_inflight(self, exc: BaseException) -> None:
+        """Fail every queued + slot-resident handle (crash path, loop
+        thread — the only other `_slots` writer is this thread)."""
+        with self._cond:
+            pending = self._queue[:]
+            self._queue.clear()
+            self._m_queue_depth.set(0)
+        for seq in pending:
+            seq.handle._finish(exc)
+            self._trace_done("cancel", seq)
+        for i, seq in enumerate(self._slots):  # graftlint: disable=CC004
+            if seq is not None:
+                if self.pool is not None:
+                    self._release_pool(seq)
+                    if self.paged:
+                        self._release_slot_blocks(i, seq)
+                seq.handle._finish(exc)
+                self._trace_done("cancel", seq, slot=i)
+                self._slots[i] = None
+        self._m_active.set(0)
+
+    def _close_request_spans(self) -> None:
+        """Close every in-flight request's open phase span WITHOUT
+        finishing its handle (supervised crash: the request lives on —
+        the supervisor opens a `recovered` span bridging the gap until
+        the resubmission's fresh `queued` begins)."""
+        if not self.tracer.enabled:
+            return
+        with self._cond:
+            seqs = self._queue[:]
+        seqs += [s for s in self._slots if s is not None]  # graftlint: disable=CC004
+        for seq in seqs:
+            self._close_phase_span(seq)
+
+    def inflight(self) -> int:
+        """Queued + slot-resident request count (the drain condition)."""
+        with self._cond:
+            n = len(self._queue)
+        return n + sum(s is not None for s in self._slots)  # graftlint: disable=CC004
+
+    def queue_depth(self) -> int:
+        """Waiting (not yet admitted) request count — the degradation
+        ladder's pressure signal."""
+        with self._cond:
+            return len(self._queue)
+
+    def warmup(self) -> None:
+        """Compile every program family up front by invoking each jitted
+        callable once per bucket shape and DISCARDING the results (the
+        programs are pure; nothing observable changes — no metrics, no
+        trace records, no pool state, no slot bookkeeping).
+
+        Why this exists: a rebuilt engine's jit caches start empty, and
+        first-call compiles block the scheduler loop mid-iteration —
+        exactly the heartbeat stall a tight supervisor watchdog reads
+        as a hang. The supervisor warms every engine it spawns INSIDE
+        the recovery/drain window it already owns, so post-swap traffic
+        runs on hot caches and the watchdog judges only real stalls."""
+        params, variables = self.net.params, self.net.variables
+        ids = jnp.zeros((self.n_slots,), jnp.int32)
+        # all-masked: every slot's state transition is frozen in-program
+        # (and paged writes redirect to the scratch page), so even the
+        # discarded outputs never held corrupted rows
+        live = jnp.zeros((self.n_slots,), bool)
+        slot0 = device_index(0)
+        one = device_index(1)
+        if self.paged:
+            for nb in self.table_buckets:
+                table = jnp.full((self.n_slots, nb), SCRATCH_BLOCK,
+                                 jnp.int32)
+                self._jstep(params, variables, ids, live, table,
+                            self._states)
+            # the FULL budgeted prefill family: one program per (chunk
+            # bucket, table bucket) pair — live dispatch selects the
+            # table bucket from the slot's DEPTH (`_table_for(written +
+            # bucket)`), so a multi-chunk prompt's later chunks use
+            # wider tables than its first; warming only the depth-0
+            # pair would leave those to compile mid-iteration after a
+            # swap, when the watchdog no longer extends warmup grace
+            for b in self.prefill_buckets:
+                for nb in self.table_buckets:
+                    table = jnp.full((self.n_slots, nb), SCRATCH_BLOCK,
+                                     jnp.int32)
+                    self._jprefill(params, variables, slot0,
+                                   jnp.zeros((b,), jnp.int32), one,
+                                   table, self._states)
+            self._jsetpos(self._states, slot0, device_index(0))
+            self._jcow(self._states, device_index(SCRATCH_BLOCK),
+                       device_index(SCRATCH_BLOCK))
+        else:
+            self._jstep(params, variables, ids, live, self._states)
+            for b in self.prefill_buckets:
+                self._jprefill(params, variables, slot0,
+                               jnp.zeros((b,), jnp.int32), one,
+                               self._states)
+            if self.pool is not None:
+                for b in self.restore_buckets:
+                    idx = np.full((b,), SCRATCH_BLOCK, np.int32)
+                    self._jrestore(self._states, slot0, jnp.asarray(idx),
+                                   one, self.pool.storage)
+                    # publish donates its storage argument — rebind, or
+                    # the pool would be left pointing at consumed
+                    # buffers. Writing slot 0's (all-zero, fresh-engine)
+                    # rows into unallocated block 0 is harmless: any
+                    # future insert() scatters real data over it.
+                    self.pool.storage = self._jpublish(
+                        self._states, slot0, device_index(0),
+                        jnp.zeros((b,), jnp.int32), self.pool.storage)
+        self._jzero(self._states, slot0)
+
+    def shed_queued(self, target_depth: int) -> int:
+        """Degradation ladder level >= 1: drop queued (never admitted)
+        requests until at most ``target_depth`` wait, lowest priority
+        first, newest first within a priority — each failed with
+        LoadSheddedError (HTTP 503, retryable). Returns how many were
+        shed."""
+        shed: List[_ActiveSeq] = []
+        with self._cond:
+            excess = len(self._queue) - max(0, int(target_depth))
+            if excess > 0:
+                # sort (priority asc, submit time desc): victims first
+                order = sorted(
+                    self._queue,
+                    key=lambda s: (s.handle.priority,
+                                   -s.handle.t_submit))[:excess]
+                doomed = set(map(id, order))
+                self._queue[:] = [s for s in self._queue
+                                  if id(s) not in doomed]
+                shed = order
+                self._m_queue_depth.set(len(self._queue))
+        for seq in shed:
+            self._m_rejected.inc()
+            seq.handle._finish(LoadSheddedError(
+                "request shed by the degradation ladder (queue under "
+                "sustained pressure); retry with backoff"))
+            self._trace_done("cancel", seq)
+        return len(shed)

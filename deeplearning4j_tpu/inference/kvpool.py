@@ -78,6 +78,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from . import failpoints
 from .metrics import MetricsRegistry
 from .trace import FlightRecorder
 
@@ -266,6 +267,7 @@ class KVPool:
         and the scheduler must preempt. The returned block is OWNED by
         the caller: it is in no trie node and no free list, so nothing
         else can touch it until `free_block` or `adopt`."""
+        failpoints.fire("pool.alloc")  # chaos seam: injected OOM/crash
         bid = self._alloc()
         self._sync_gauges()
         return bid

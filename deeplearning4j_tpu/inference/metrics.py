@@ -16,6 +16,14 @@ Everything is wired through a :class:`MetricsRegistry` so the serving stack
 (`serving/server.py` `GET /metrics`), the UI snapshot poster
 (`ui/listeners.post_serving_metrics`) and the bench harness all read ONE
 source of truth.
+
+Robustness instruments (`inference/supervisor.py`, `inference/
+failpoints.py`): ``engine_restarts_total`` / ``requests_recovered_total``
+/ ``requests_abandoned_total`` / ``requests_shed_total`` counters,
+``serving_ready`` (the /readyz verdict as a scrapeable 0/1 — its
+high-water ``_max`` being 1 with value 0 is the "was ready, went
+unready" alert) and ``degradation_level`` gauges, and
+``failpoint_triggers_total`` counting injected chaos faults.
 """
 from __future__ import annotations
 

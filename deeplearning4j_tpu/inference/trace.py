@@ -46,6 +46,16 @@ Record taxonomy (the span tree every request gets):
   waterfall shows exactly where its wall time went while its blocks
   were lent out.
 
+  Fault tolerance (`inference/supervisor.py`) adds a ``supervisor``
+  track: ``engine_crash``/``engine_hang`` (the watchdog's verdict,
+  with the exception type and iteration count), ``engine_restart``
+  (backoff taken, requests recovering), ``degrade`` (ladder level
+  changes), ``drain_begin``/``drain_swap``, and ``warmup_skipped``;
+  plus a per-request ``recovered`` span on the request track bridging
+  the gap between the crash and the resubmission's fresh ``queued`` —
+  a recovered request's waterfall shows the outage it survived, and
+  its ``finish`` instant carries a ``retries`` count.
+
 Tracks: every record resolves to a named track at append time — a slot
 track (``slot N``), a request track (``request <id>``), or a named
 component track (``scheduler``, ``predict``, ``kvpool``, ``http``). The

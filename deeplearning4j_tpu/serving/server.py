@@ -49,8 +49,29 @@ exports the ring — structured JSON or Chrome trace-event format
 (`?format=chrome`, Perfetto-loadable; `python -m
 deeplearning4j_tpu.inference.trace dump` fetches it to a file).
 
+Fault tolerance (`inference/supervisor.py`, `inference/failpoints.py`):
+the decode engine runs under an EngineSupervisor by default
+(``supervise=False`` opts out) — a watchdog consumes the scheduler
+loop's per-iteration heartbeat, and a crashed or hung engine is fenced,
+rebuilt, and every in-flight request resubmitted onto the replacement
+with its original handle and seed (token-identical recovery; bounded
+exponential backoff + per-request retry budget, exhaustion -> structured
+503 carrying the ``request_id``). Sustained queue pressure walks a
+graceful-degradation ladder (shed low-priority queued load -> halve the
+prefill chunk -> reject with ``Retry-After``), `POST /admin/drain` does
+a zero-dropped-request engine swap, and `GET /healthz` / `GET /readyz`
+split liveness from readiness so a load balancer stops routing DURING
+recovery and resumes after. Chaos seams (`--failpoint name=spec`, env
+``DL4J_FAILPOINTS``, or the opt-in `POST /admin/failpoints`) inject
+deterministic crashes/hangs/OOMs for drills; `tests/test_chaos.py`
+proves the no-lost-request / token-identity invariants per seam.
+See ``docs/robustness.md`` for the failure model and runbook.
+
 Endpoints:
   GET  /health            {"status": "ok", "model": "...", "params": N}
+  GET  /healthz           liveness: process answers (always 200)
+  GET  /readyz            readiness: 200 while heartbeat fresh AND not
+                          draining/recovering, else 503 (+ status body)
   GET  /info              model summary + config JSON
   GET  /metrics           SLO metrics snapshot (?format=text for a
                           Prometheus-flavored exposition)
@@ -70,6 +91,12 @@ Endpoints:
                           expiry CANCELS the decode (slot reclaimed) ->
                           HTTP 504; a full decode queue -> HTTP 503; a
                           prompt that cannot fit the KV cache -> HTTP 413
+  POST /admin/drain       draining restart: stop admitting, finish
+                          in-flight, swap the engine, resume (202; watch
+                          /readyz flip)
+  GET/POST /admin/failpoints  chaos control (opt-in failpoint_endpoint):
+                          {"name": seam, "spec": "crash@n:3"} arms,
+                          spec null disarms, name "*" disarms all
 """
 from __future__ import annotations
 
@@ -83,9 +110,12 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from ..inference import (DecodeScheduler, MetricsRegistry, MicroBatcher,
+from ..inference import (AdmissionRejectedError, DecodeScheduler,
+                         EngineSupervisor, MetricsRegistry, MicroBatcher,
                          PromptTooLongError, QueueFullError,
-                         RequestTimeoutError)
+                         RequestTimeoutError, RetryBudgetExceededError,
+                         ShuttingDownError, failpoints)
+from ..inference.failpoints import InjectedFault
 from ..inference.trace import FlightRecorder, new_request_id
 from .streaming import RecordToDataSetConverter
 
@@ -111,7 +141,11 @@ class InferenceServer:
                  kv_pool_mb: float = 0.0,
                  metrics: Optional[MetricsRegistry] = None,
                  trace_buffer: int = 8192,
-                 tracer: Optional[FlightRecorder] = None):
+                 tracer: Optional[FlightRecorder] = None,
+                 supervise: bool = True, hang_timeout_s: float = 5.0,
+                 retry_budget: int = 3,
+                 decode_transfer_guard: Optional[str] = None,
+                 failpoint_endpoint: bool = False):
         if net is None:
             if model_path is None:
                 raise ValueError("pass a net or a model_path")
@@ -132,7 +166,21 @@ class InferenceServer:
         self.prefix_cache_mb = float(prefix_cache_mb)
         self.kv_block = int(kv_block)
         self.kv_pool_mb = float(kv_pool_mb)
-        self._decoder: Optional[DecodeScheduler] = None
+        # fault tolerance (inference/supervisor.py): the decode engine
+        # is owned by an EngineSupervisor — watchdog, crash recovery
+        # with request requeue, degradation ladder, draining restarts —
+        # unless supervise=False restores the bare scheduler
+        self.supervise = bool(supervise)
+        self.hang_timeout_s = float(hang_timeout_s)
+        self.retry_budget = int(retry_budget)
+        self.decode_transfer_guard = decode_transfer_guard
+        # test-only chaos control plane (POST /admin/failpoints): must
+        # be opted into — a production server must not let clients arm
+        # crash seams
+        self.failpoint_endpoint = bool(failpoint_endpoint)
+        self.supervisor: Optional[EngineSupervisor] = None
+        self._decoder_direct: Optional[DecodeScheduler] = None
+        self._shutting_down = False
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         # per-server flight recorder (like the per-server MetricsRegistry:
         # one source of truth this server's `GET /trace` reads back);
@@ -156,6 +204,37 @@ class InferenceServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1] if self._httpd else self._port
+
+    @property
+    def _decoder(self) -> Optional[DecodeScheduler]:
+        """The LIVE decode scheduler: supervised servers swap engines on
+        crash recovery / drain, so this must always resolve through the
+        supervisor rather than pinning the first instance."""
+        if self.supervisor is not None:
+            return self.supervisor.engine
+        return self._decoder_direct
+
+    def _decoder_factory(self) -> DecodeScheduler:
+        return DecodeScheduler(
+            self.net, self.decode_vocab, n_slots=self.decode_slots,
+            max_queue=self.decode_queue,
+            prefill_chunk=self.prefill_chunk,
+            prefix_cache_mb=self.prefix_cache_mb,
+            kv_block=self.kv_block,
+            kv_pool_mb=self.kv_pool_mb,
+            transfer_guard=self.decode_transfer_guard,
+            metrics=self.metrics, tracer=self.tracer)
+
+    def ready(self) -> Tuple[bool, dict]:
+        """`/readyz` verdict + body. Unsupervised servers are ready
+        while not shutting down (there is no watchdog to vouch for the
+        engine, and the prediction path has no engine at all)."""
+        if self._shutting_down:
+            return False, {"ready": False, "reason": "shutting_down"}
+        if self.supervisor is not None:
+            status = self.supervisor.status()
+            return status["ready"], status
+        return True, {"ready": True}
 
     def _net_output(self, arr: np.ndarray) -> np.ndarray:
         """One forward through either facade. ComputationGraph.output
@@ -211,14 +290,20 @@ class InferenceServer:
 
     def _generate(self, payload: dict, timeout_ms: Optional[float],
                   request_id: Optional[str] = None) -> dict:
-        if self._decoder is None:
+        gen = (self.supervisor if self.supervisor is not None
+               else self._decoder_direct)
+        if gen is None:
             raise ValueError("generation is disabled: start the server "
                              "with decode_vocab (CLI: --generate)")
         if timeout_ms is None:
             timeout_ms = self.default_timeout_ms
         kw = {k: payload[k] for k in ("temperature", "top_k", "top_p",
-                                      "seed", "eos_id") if k in payload}
-        handle = self._decoder.generate_handle(
+                                      "seed", "eos_id", "priority")
+              if k in payload}
+        # supervised: the supervisor tracks the request for crash
+        # recovery (an engine restart resubmits it, same handle, same
+        # seed — the client never sees the crash)
+        handle = gen.generate_handle(
             [int(t) for t in payload["prompt"]],
             int(payload.get("max_new_tokens", 16)),
             timeout=timeout_ms / 1e3 if timeout_ms is not None else 120.0,
@@ -226,20 +311,25 @@ class InferenceServer:
         # the per-request observability payload: the id the client can
         # quote (X-Request-Id carries it too) and the phase breakdown
         # whose four segments sum to the end-to-end latency
-        return {"tokens": handle.tokens, "request_id": handle.request_id,
-                "timings": handle.timings()}
+        out = {"tokens": handle.tokens, "request_id": handle.request_id,
+               "timings": handle.timings()}
+        if handle.retries:
+            out["retries"] = handle.retries  # survived engine crash(es)
+        return out
 
     def start(self) -> "InferenceServer":
         server = self
+        self._shutting_down = False
+        failpoints.bind_metrics(self.metrics)
         if self.decode_vocab is not None and self._decoder is None:
-            self._decoder = DecodeScheduler(
-                self.net, self.decode_vocab, n_slots=self.decode_slots,
-                max_queue=self.decode_queue,
-                prefill_chunk=self.prefill_chunk,
-                prefix_cache_mb=self.prefix_cache_mb,
-                kv_block=self.kv_block,
-                kv_pool_mb=self.kv_pool_mb,
-                metrics=self.metrics, tracer=self.tracer).start()
+            if self.supervise:
+                self.supervisor = EngineSupervisor(
+                    self._decoder_factory,
+                    hang_timeout_s=self.hang_timeout_s,
+                    retry_budget=self.retry_budget,
+                    metrics=self.metrics, tracer=self.tracer)
+            else:
+                self._decoder_direct = self._decoder_factory().start()
         m_http = self.metrics.counter("http_requests_total")
         m_err = self.metrics.counter("http_errors_total")
 
@@ -248,7 +338,7 @@ class InferenceServer:
                 pass
 
             def _send(self, obj, code=200, content_type="application/json",
-                      request_id=None):
+                      request_id=None, headers=None):
                 body = (obj if isinstance(obj, bytes)
                         else json.dumps(obj).encode())
                 self.send_response(code)
@@ -258,6 +348,8 @@ class InferenceServer:
                     # clients quote this id when reporting a slow/failed
                     # request; it keys straight into GET /trace
                     self.send_header("X-Request-Id", request_id)
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -268,6 +360,27 @@ class InferenceServer:
                     self._send({"status": "ok",
                                 "model": type(server.net).__name__,
                                 "params": server.net.num_params()})
+                elif url.path == "/healthz":
+                    # liveness: the process answers. Nothing else — a
+                    # crashed engine mid-recovery is still a LIVE
+                    # process (restart-looping it would only make the
+                    # outage worse); that distinction is /readyz's job
+                    self._send({"status": "up"})
+                elif url.path == "/readyz":
+                    # readiness: able to take traffic NOW (watchdog
+                    # heartbeat fresh AND not draining/recovering) —
+                    # load balancers route on this, so it flips unready
+                    # for the recovery window and back after
+                    ok, body = server.ready()
+                    self._send(body, 200 if ok else 503)
+                elif url.path == "/admin/failpoints":
+                    if not server.failpoint_endpoint:
+                        return self._send(
+                            {"error": "failpoint endpoint disabled "
+                             "(start the server with "
+                             "failpoint_endpoint=True)"}, 403)
+                    self._send({"armed": failpoints.snapshot(),
+                                "seams": list(failpoints.SEAMS)})
                 elif url.path == "/info":
                     self._send({"model": type(server.net).__name__,
                                 "config": json.loads(server.net.conf.to_json()),
@@ -322,7 +435,47 @@ class InferenceServer:
                              "request_id": rid}, 400, request_id=rid)
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n)
+                if server._shutting_down:
+                    # stop() raced an in-flight POST: fail FAST with a
+                    # structured 503 instead of letting the handler run
+                    # into half-torn-down components and hang its client
+                    m_err.inc()
+                    return self._send({"error": "shutting_down",
+                                       "request_id": rid}, 503,
+                                      request_id=rid)
                 try:
+                    if url.path == "/admin/drain":
+                        if server.supervisor is None:
+                            return self._send(
+                                {"error": "draining needs a supervised "
+                                 "decode engine (supervise=True + "
+                                 "decode_vocab)", "request_id": rid},
+                                400, request_id=rid)
+                        server.supervisor.drain_async()
+                        return self._send(
+                            {"status": "draining", "request_id": rid,
+                             **server.supervisor.status()}, 202,
+                            request_id=rid)
+                    if url.path == "/admin/failpoints":
+                        if not server.failpoint_endpoint:
+                            return self._send(
+                                {"error": "failpoint endpoint disabled",
+                                 "request_id": rid}, 403, request_id=rid)
+                        payload = json.loads(raw.decode())
+                        name = payload["name"]
+                        spec = payload.get("spec")
+                        if spec:
+                            failpoints.arm(name, spec)
+                        else:
+                            failpoints.disarm(None if name == "*"
+                                              else name)
+                        return self._send(
+                            {"armed": failpoints.snapshot(),
+                             "request_id": rid}, request_id=rid)
+                    # chaos seam AFTER the /admin/* branches: an armed
+                    # http.handler seam must not be able to block its
+                    # own HTTP disarm path (control-plane lockout)
+                    failpoints.fire("http.handler")
                     if url.path == "/predict/csv":
                         rows = [line.split(",") for line in
                                 raw.decode().strip().splitlines() if line.strip()]
@@ -365,12 +518,48 @@ class InferenceServer:
                         "request_id": rid, "reason": "timeout_504"})
                     self._send({"error": f"deadline exceeded: {e}",
                                 "request_id": rid}, 504, request_id=rid)
+                except RetryBudgetExceededError as e:
+                    # every attempt saw the engine die: a structured 503
+                    # naming the request — never silence (the satellite
+                    # invariant: exhaustion answers, it does not hang)
+                    m_err.inc()
+                    server.tracer.instant("reject", track="http", args={
+                        "request_id": rid,
+                        "reason": "retry_budget_exhausted"})
+                    self._send({"error": "retry_budget_exhausted",
+                                "detail": str(e), "request_id": rid},
+                               503, request_id=rid)
+                except ShuttingDownError:
+                    m_err.inc()
+                    self._send({"error": "shutting_down",
+                                "request_id": rid}, 503, request_id=rid)
+                except AdmissionRejectedError as e:
+                    # degradation ladder level 3 / draining restart:
+                    # Retry-After tells well-behaved clients how long to
+                    # back off (examples/serving_load_test.py honors it)
+                    m_err.inc()
+                    server.tracer.instant("reject", track="http", args={
+                        "request_id": rid, "reason": "degraded_503"})
+                    self._send(
+                        {"error": "not_admitting", "detail": str(e),
+                         "retry_after_s": e.retry_after_s,
+                         "request_id": rid}, 503, request_id=rid,
+                        headers={"Retry-After":
+                                 str(max(1, int(e.retry_after_s)))})
                 except QueueFullError as e:
                     m_err.inc()
                     server.tracer.instant("reject", track="http", args={
                         "request_id": rid, "reason": "backpressure_503"})
                     self._send({"error": f"over capacity: {e}",
                                 "request_id": rid}, 503, request_id=rid)
+                except InjectedFault as e:
+                    # a chaos seam fired in the HTTP layer itself (or an
+                    # injected fault escaped a lower layer): a 5xx —
+                    # retryable server fault, NOT a 400 client error
+                    m_err.inc()
+                    self._send({"error": "injected_fault",
+                                "seam": e.seam, "request_id": rid}, 500,
+                               request_id=rid)
                 except Exception as e:  # bad payloads must not kill the server
                     m_err.inc()
                     self._send({"error": str(e), "request_id": rid}, 400,
@@ -383,13 +572,22 @@ class InferenceServer:
         return self
 
     def stop(self) -> None:
+        # flag FIRST: handler threads that already passed accept see it
+        # and answer a structured 503 ("shutting_down", request_id
+        # echoed) instead of racing the teardown below into a hang
+        self._shutting_down = True
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
-        if self._decoder is not None:
-            self._decoder.stop()
-            self._decoder = None
+        if self.supervisor is not None:
+            # fails every tracked in-flight request fast with
+            # ShuttingDownError -> the blocked POST handlers respond 503
+            self.supervisor.stop()
+            self.supervisor = None
+        if self._decoder_direct is not None:
+            self._decoder_direct.stop()
+            self._decoder_direct = None
         with self._batchers_lock:
             batchers = list(self._batchers.values())
             self._batchers.clear()

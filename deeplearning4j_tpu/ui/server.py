@@ -282,6 +282,7 @@ _SERVING_PAGE = """<!DOCTYPE html>
 <div id="meta"></div>
 <div id="decode" style="color:#555"></div>
 <div id="kvpool" style="color:#555"></div>
+<div id="robust" style="color:#555"></div>
 <div id="trace" style="font-family:monospace;font-size:12px"></div>
 <table id="t" border="1" cellpadding="4" style="border-collapse:collapse">
 </table>
@@ -306,7 +307,10 @@ function waterfall(r) {
       (+r[k] || 0) + 'ms"></span>';
   }
   return '<div>' + esc(r.request_id) + ' ' + (r.outcome === 'cancel' ?
-    'CANCELLED' : (+r.tokens || 0) + ' tok') + ' ' + total.toFixed(1) +
+    'CANCELLED' : (+r.tokens || 0) + ' tok') +
+    (r.retries ? ' <b title="survived ' + (+r.retries) +
+      ' engine restart(s)">&#10227;' + (+r.retries) + '</b>' : '') +
+    ' ' + total.toFixed(1) +
     'ms ' + bars + ' <span style="color:#888">queue ' +
     (+r.queue_ms || 0) + ' | restore ' + (+r.restore_ms || 0) +
     ' | prefill ' + (+r.prefill_ms || 0) + ' | decode ' +
@@ -353,6 +357,22 @@ async function refresh() {
         g.kv_pool_blocks_live.max : 0) + ')' +
       (c.decode_preempted_total ? ', ' + c.decode_preempted_total +
         ' preempted' : '');
+  // fault-tolerance line (inference/supervisor.py): readiness, engine
+  // restarts, recovered/abandoned requests, degradation rung, chaos
+  // triggers — the at-a-glance "is the supervisor earning its keep"
+  if (g.serving_ready !== undefined || c.engine_restarts_total)
+    document.getElementById('robust').innerText =
+      'robustness: ' + ((g.serving_ready || {}).value ? 'READY'
+        : 'NOT READY') +
+      ', ' + (c.engine_restarts_total || 0) + ' engine restart(s), ' +
+      (c.requests_recovered_total || 0) + ' recovered' +
+      (c.requests_abandoned_total ? ', ' + c.requests_abandoned_total +
+        ' abandoned (retry budget)' : '') +
+      (c.requests_shed_total ? ', ' + c.requests_shed_total +
+        ' shed' : '') +
+      ', degradation L' + ((g.degradation_level || {}).value || 0) +
+      (c.failpoint_triggers_total ? ', ' + c.failpoint_triggers_total +
+        ' failpoint trigger(s)' : '');
   let rows = '<tr><th>metric</th><th>value</th></tr>';
   for (const [k, v] of Object.entries(m.counters || {}))
     rows += '<tr><td>' + k + '</td><td>' + v + '</td></tr>';

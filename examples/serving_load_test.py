@@ -14,6 +14,14 @@ FILE` dumps the server's flight recorder as Chrome trace-event JSON —
 open it at https://ui.perfetto.dev to see one track per decode slot
 (interleaved prefill chunks) and one per request (queued/prefill/decode).
 
+Chaos-compatible (ISSUE 7): the HTTP client retries connection-refused
+and 5xx responses with capped exponential backoff and honors 503
+``Retry-After`` hints, so a run against a server under failpoint
+injection or a draining restart rides the outage out instead of
+aborting; per-request retry counts (and server-side engine-restart
+recoveries, the ``retries`` field in /generate responses) are reported
+at the end.
+
     python examples/serving_load_test.py            # batched only
     python examples/serving_load_test.py --compare  # batched vs serialized
     python examples/serving_load_test.py --generate --trace-out trace.json
@@ -22,6 +30,7 @@ import argparse
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -41,21 +50,62 @@ def _make_net(n_in=64, hidden=256, n_out=10):
     return MultiLayerNetwork(b.build()).init()
 
 
-def _post(port, path, body):
-    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=body,
-                                 headers={"Content-Type": "application/json"})
-    return json.loads(urllib.request.urlopen(req).read())
+# retry policy for chaos / draining-restart runs: the server may answer
+# 5xx (engine recovering, degradation ladder, injected HTTP fault) or
+# refuse the connection entirely for a moment — the load generator must
+# ride that out, not abort the run. 4xx (client errors) never retry.
+_MAX_RETRIES = 8
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 2.0
+
+
+def _post(port, path, body, retries=None):
+    """POST with capped exponential backoff on connection-refused/5xx.
+    Honors a 503's ``Retry-After`` header (the degradation ladder's
+    explicit back-off hint) over the computed delay. Returns the parsed
+    JSON; when a ``retries`` list is passed, the number of retries this
+    request needed is appended to it (the per-request retry record)."""
+    attempt = 0
+    while True:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            out = json.loads(urllib.request.urlopen(req).read())
+            if retries is not None:
+                retries.append(attempt)
+            return out
+        except urllib.error.HTTPError as e:
+            if e.code < 500 and e.code != 503:
+                raise  # a client error will not improve with retries
+            delay = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * (2 ** attempt))
+            ra = e.headers.get("Retry-After") if e.headers else None
+            if ra:
+                try:
+                    delay = max(delay, float(ra))
+                except ValueError:
+                    pass
+            e.read()  # drain so the connection can be reused
+        except urllib.error.URLError:
+            # connection refused/reset: the server is mid-restart
+            delay = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * (2 ** attempt))
+        attempt += 1
+        if attempt > _MAX_RETRIES:
+            raise RuntimeError(
+                f"{path}: gave up after {_MAX_RETRIES} retries")
+        time.sleep(delay)
 
 
 def _drive(server, n_threads, reqs_each, body):
     _post(server.port, "/predict", body)  # warm the jitted buckets
     errors = []
+    retry_counts = []  # per-request attempts beyond the first
     t0 = time.perf_counter()
 
     def client():
         for _ in range(reqs_each):
             try:
-                _post(server.port, "/predict", body)
+                _post(server.port, "/predict", body, retries=retry_counts)
             except Exception as e:  # keep driving; report at the end
                 errors.append(repr(e))
 
@@ -65,7 +115,7 @@ def _drive(server, n_threads, reqs_each, body):
     for t in threads:
         t.join()
     elapsed = time.perf_counter() - t0
-    return n_threads * reqs_each / elapsed, errors
+    return n_threads * reqs_each / elapsed, errors, retry_counts
 
 
 def _make_lm(vocab=32, cache=96):
@@ -89,7 +139,7 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
                           prefill_chunk=16, prefix_cache_mb=16,
                           kv_block=8).start()
     rng = np.random.default_rng(0)
-    results, errors = [], []
+    results, errors, retry_counts = [], [], []
     # prompts pre-built on the main thread (numpy Generators are not
     # thread-safe); a few repeats so the prefix cache has something to hit
     bodies = [json.dumps(
@@ -105,7 +155,8 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
             try:
                 results.append(_post(srv.port, "/generate",
                                      bodies[(k * reqs_each + i)
-                                            % len(bodies)]))
+                                            % len(bodies)],
+                                     retries=retry_counts))
             except Exception as e:
                 errors.append(repr(e))
 
@@ -132,7 +183,15 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
     assert not errors, errors
     if verbose:
         tok_s = len(results) * new_tokens / elapsed
-        print(f"generate:   {len(results)} requests, {tok_s:8.1f} tok/s")
+        retried = sum(1 for n in retry_counts if n)
+        print(f"generate:   {len(results)} requests, {tok_s:8.1f} tok/s"
+              + (f"  (HTTP retries: {sum(retry_counts)} across {retried} "
+                 f"request(s), max {max(retry_counts)})"
+                 if retried else ""))
+        recov = [r for r in results if r.get("retries")]
+        if recov:  # server-side crash recoveries (engine restarts)
+            print(f"recovered:  {len(recov)} request(s) survived an "
+                  "engine restart transparently")
         for r in results[-6:]:  # waterfall: where each request's time went
             t = r["timings"]
             print(f"  {r['request_id']}  total {t['total_ms']:7.1f}ms = "
@@ -155,7 +214,7 @@ def main(n_threads=8, reqs_each=10, rows=8, compare=False, verbose=True):
     srv = InferenceServer(net=net, batching=True, batch_window_ms=1.0,
                           max_batch=64).start()
     try:
-        rps, errors = _drive(srv, n_threads, reqs_each, body)
+        rps, errors, retry_counts = _drive(srv, n_threads, reqs_each, body)
         metrics = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/metrics").read())
     finally:
@@ -163,10 +222,11 @@ def main(n_threads=8, reqs_each=10, rows=8, compare=False, verbose=True):
     occ = metrics["histograms"]["predict_batch_occupancy"].get("mean", 0)
     lat = metrics["histograms"]["predict_latency_sec"]
     if verbose:
+        retried = sum(1 for n in retry_counts if n)
         print(f"batched:    {rps:8.1f} req/s  "
               f"(occupancy {occ:.2f}, queue-depth max "
               f"{metrics['gauges']['predict_queue_depth']['max']:.0f}, "
-              f"errors {len(errors)})")
+              f"errors {len(errors)}, retried requests {retried})")
         if lat.get("count"):
             print(f"latency:    p50 {lat['p50'] * 1e3:.2f}ms  "
                   f"p95 {lat['p95'] * 1e3:.2f}ms  "
@@ -174,7 +234,7 @@ def main(n_threads=8, reqs_each=10, rows=8, compare=False, verbose=True):
     if compare:
         srv = InferenceServer(net=net, batching=False).start()
         try:
-            serial_rps, _ = _drive(srv, n_threads, reqs_each, body)
+            serial_rps, _, _ = _drive(srv, n_threads, reqs_each, body)
         finally:
             srv.stop()
         if verbose:

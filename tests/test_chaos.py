@@ -1,0 +1,318 @@
+"""Chaos suite (ISSUE 7 acceptance): every failpoint seam armed in turn
+under concurrent load, against the full HTTP serving stack.
+
+The invariant that matters, per seam:
+
+  - **no request lost** — every client request eventually completes
+    (the retrying client rides 5xx windows, exactly like
+    `examples/serving_load_test.py`);
+  - **none answered twice** — each request_id has at most one terminal
+    `finish` record in the flight recorder (a fenced zombie engine
+    cannot double-finish a handle its replacement owns);
+  - **token identity** — every completion matches the no-fault run
+    bit-for-bit, with the engine under ``transfer_guard="disallow"``
+    (crash recovery reseeds and re-prefills; greedy AND seeded-sampled
+    requests must reproduce);
+  - `/readyz` flips unready during recovery and ready after;
+  - the rebuilt engine's CompileCounter budgets are clean (a restart
+    re-jits the same bucketed program families, nothing per-length).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.inference import MetricsRegistry, failpoints
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.serving import InferenceServer
+
+V = 13
+N_CLIENTS = 4
+REQS_EACH = 2
+NEW_TOKENS = 8
+
+
+def _lm(cache=96):
+    conf = transformer_lm(vocab_size=V, d_model=16, n_heads=2, n_blocks=2,
+                          rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = cache
+    return ComputationGraph(conf).init()
+
+
+def _post_retry(port, path, body, timeout=120, max_retries=10):
+    """The chaos client: capped-backoff retries on 5xx / connection
+    errors, Retry-After honored — a request is only 'lost' if even this
+    gives up."""
+    attempt = 0
+    while True:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            return json.loads(urllib.request.urlopen(req, timeout=timeout)
+                              .read())
+        except urllib.error.HTTPError as e:
+            if e.code < 500 and e.code != 503:
+                raise
+            delay = min(1.0, 0.05 * (2 ** attempt))
+            ra = e.headers.get("Retry-After") if e.headers else None
+            if ra:
+                delay = max(delay, float(ra))
+            e.read()
+        except urllib.error.URLError:
+            delay = min(1.0, 0.05 * (2 ** attempt))
+        attempt += 1
+        if attempt > max_retries:
+            raise RuntimeError(f"request lost: {max_retries} retries "
+                               "exhausted")
+        time.sleep(delay)
+
+
+def _drive_generate(srv, prompts):
+    """Concurrent /generate load over the fixed prompt/seed mix (half
+    greedy, half seeded-sampled). Returns outputs keyed by request
+    index — exactly comparable across runs."""
+    out = [None] * len(prompts)
+    errors = []
+
+    def client(k):
+        for i in range(k, len(prompts), N_CLIENTS):
+            prompt, kw = prompts[i]
+            body = json.dumps({"prompt": prompt,
+                               "max_new_tokens": NEW_TOKENS, **kw}).encode()
+            try:
+                out[i] = _post_retry(srv.port, "/generate", body)
+            except Exception as e:  # noqa: BLE001 - the lost-request record
+                errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(N_CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, f"requests lost under chaos: {errors}"
+    return out
+
+
+def _finish_counts(tracer):
+    """request_id -> number of terminal `finish` records (the answered-
+    twice detector)."""
+    counts = {}
+    for ev in tracer.events():
+        if ev["ph"] == "i" and ev["name"] == "finish":
+            rid = ev.get("args", {}).get("request_id")
+            if rid:
+                counts[rid] = counts.get(rid, 0) + 1
+    return counts
+
+
+def _mk_prompts():
+    rng = np.random.default_rng(42)
+    prompts = []
+    for i in range(N_CLIENTS * REQS_EACH):
+        p = [int(t) for t in rng.integers(0, V, int(rng.integers(5, 40)))]
+        kw = ({} if i % 2 == 0 else
+              {"temperature": 0.9, "top_k": 5, "seed": 1000 + i})
+        prompts.append((p, kw))
+    return prompts
+
+
+@pytest.fixture(scope="module")
+def decode_server():
+    """One supervised /generate server shared by the engine-seam cases
+    (each case arms, drives, disarms, waits ready). transfer_guard=
+    "disallow" keeps the device-residency audit on THROUGH the crashes."""
+    srv = InferenceServer(net=_lm(), decode_vocab=V, decode_slots=2,
+                          prefill_chunk=16, hang_timeout_s=0.6,
+                          retry_budget=6,
+                          decode_transfer_guard="disallow").start()
+    srv.supervisor.poll_interval_s = 0.02
+    srv.supervisor.backoff_base_s = 0.01
+    srv.supervisor.backoff_max_s = 0.1
+    yield srv
+    failpoints.disarm()
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def reference(decode_server):
+    """The no-fault run: same server, nothing armed."""
+    prompts = _mk_prompts()
+    outs = _drive_generate(decode_server, prompts)
+    return prompts, [o["tokens"] for o in outs]
+
+
+def _await_ready(srv, deadline_s=60):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        ok, _ = srv.ready()
+        if ok:
+            return
+        time.sleep(0.02)
+    raise AssertionError("server never became ready again")
+
+
+@pytest.mark.parametrize("seam,spec", [
+    ("scheduler.iteration", "crash@n:4"),
+    ("dispatch.decode", "crash@once"),
+    ("dispatch.prefill", "crash@once"),
+    ("scheduler.iteration", "hang:1200@once"),
+    ("http.handler", "crash@n:3"),
+])
+def test_seam_armed_no_loss_no_dup_token_identical(decode_server,
+                                                   reference, seam, spec):
+    srv = decode_server
+    prompts, expected = reference
+    before_restarts = srv.supervisor.restarts
+    triggers_before = srv.metrics.counter("failpoint_triggers_total").value
+    failpoints.arm(seam, spec)
+    try:
+        outs = _drive_generate(srv, prompts)
+    finally:
+        failpoints.disarm()
+    _await_ready(srv)
+    # the seam really fired (a vacuous pass would prove nothing)
+    assert srv.metrics.counter("failpoint_triggers_total").value \
+        > triggers_before
+    # token identity vs the no-fault run — greedy AND seeded-sampled
+    assert [o["tokens"] for o in outs] == expected, f"seam {seam}"
+    # none answered twice: each request_id finished at most once
+    dups = {rid: n for rid, n in
+            _finish_counts(srv.tracer).items() if n > 1}
+    assert not dups, f"double-finished requests under {seam}: {dups}"
+    if seam != "http.handler":
+        # engine seams force at least one supervised restart...
+        assert srv.supervisor.restarts > before_restarts
+        # ...whose rebuilt engine holds the same compile budgets
+        assert srv.supervisor.engine._compile_counter.check() == []
+    # recovered requests carry their retry count in the response
+    if seam.startswith("dispatch") or seam == "scheduler.iteration":
+        assert any(o.get("retries") for o in outs), \
+            "no request reports surviving the restart"
+
+
+def test_readyz_flips_unready_during_recovery_and_back(decode_server,
+                                                       reference):
+    """/readyz is the load balancer's routing signal: it must go 503
+    inside the recovery window and 200 after."""
+    srv = decode_server
+    prompts, expected = reference
+    readyz_codes = []
+    stop_probe = threading.Event()
+
+    def probe():
+        while not stop_probe.is_set():
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/readyz", timeout=10)
+                readyz_codes.append(200)
+            except urllib.error.HTTPError as e:
+                readyz_codes.append(e.code)
+                e.read()
+            time.sleep(0.01)
+
+    th = threading.Thread(target=probe)
+    th.start()
+    # a hang long enough that the unready window (detection at ~0.6s
+    # until the rebuilt engine is warm) spans several probe samples
+    failpoints.arm("scheduler.iteration", "hang:1500@once")
+    try:
+        outs = _drive_generate(srv, prompts)
+    finally:
+        failpoints.disarm()
+        _await_ready(srv)
+        stop_probe.set()
+        th.join(timeout=10)
+    assert [o["tokens"] for o in outs] == expected
+    assert 503 in readyz_codes, "readyz never flipped unready"
+    assert readyz_codes[-1] == 200, "readyz did not recover"
+
+
+def test_pool_alloc_oom_seam_paged_engine():
+    """InjectedOOM out of KVPool.alloc kills the paged engine's loop;
+    recovery rebuilds pool + tables and replays — token-identical."""
+    net = _lm()
+    srv = InferenceServer(net=net, decode_vocab=V, decode_slots=4,
+                          prefill_chunk=16, kv_pool_mb=1.0, kv_block=8,
+                          hang_timeout_s=30.0, retry_budget=6,
+                          decode_transfer_guard="disallow").start()
+    srv.supervisor.backoff_base_s = 0.01
+    srv.supervisor.backoff_max_s = 0.1
+    try:
+        assert srv.supervisor.engine.paged
+        prompts = _mk_prompts()
+        expected = [o["tokens"]
+                    for o in _drive_generate(srv, prompts)]
+        failpoints.arm("pool.alloc", "oom@n:2")
+        try:
+            outs = _drive_generate(srv, prompts)
+        finally:
+            failpoints.disarm()
+        assert [o["tokens"] for o in outs] == expected
+        assert srv.supervisor.restarts >= 1
+        assert srv.supervisor.engine._compile_counter.check() == []
+        dups = {rid: n for rid, n in
+                _finish_counts(srv.tracer).items() if n > 1}
+        assert not dups
+    finally:
+        failpoints.disarm()
+        srv.stop()
+
+
+def test_batcher_flush_seam_predict_path():
+    """An injected crash in the micro-batcher dispatch fails that batch's
+    futures -> HTTP 500 -> the retrying client resubmits -> predictions
+    match the fault-free ones (row-identical)."""
+    from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    b = NeuralNetConfiguration.builder().seed(1).learning_rate(0.01).list()
+    b.layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+    b.layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                        loss="mcxent"))
+    net = MultiLayerNetwork(b.build()).init()
+    srv = InferenceServer(net=net, batching=True,
+                          batch_window_ms=1.0).start()
+    try:
+        rng = np.random.default_rng(0)
+        rows = rng.standard_normal((4, 8)).tolist()
+        body = json.dumps({"data": rows}).encode()
+        expected = _post_retry(srv.port, "/predict", body)
+        failpoints.arm("batcher.flush", "crash@once")
+        try:
+            out = _post_retry(srv.port, "/predict", body)
+        finally:
+            failpoints.disarm()
+        assert out["predictions"] == expected["predictions"]
+        assert srv.metrics.counter("failpoint_triggers_total").value >= 1
+    finally:
+        failpoints.disarm()
+        srv.stop()
+
+
+def test_chrome_export_carries_recovery_records(decode_server):
+    """The recovered span + engine_crash/engine_restart instants are in
+    the Chrome export (Perfetto-loadable: every B has a matching E)."""
+    trace = decode_server.tracer.chrome_trace()
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"engine_restart", "recovered"} <= names, sorted(names)
+    assert "engine_crash" in names or "engine_hang" in names
+    # B/E pairing sanity on every track (the exporter's contract)
+    opens = {}
+    for ev in trace["traceEvents"]:
+        key = (ev.get("pid"), ev.get("tid"))
+        if ev["ph"] == "B":
+            opens[key] = opens.get(key, 0) + 1
+        elif ev["ph"] == "E":
+            opens[key] = opens.get(key, 0) - 1
+            assert opens[key] >= 0, "E without matching B"
+    assert all(v == 0 for v in opens.values()), "unclosed spans"

@@ -23,6 +23,7 @@ from deeplearning4j_tpu.analysis.jax_rules import (HostSyncInJit,
                                                    JitMissingStatics,
                                                    JitMutableGlobal,
                                                    HostSyncInHotLoop,
+                                                   SwallowedExceptionInThread,
                                                    TracerBranch)
 from deeplearning4j_tpu.analysis.lint import main as lint_main
 
@@ -288,6 +289,72 @@ def test_jg006_host_sync_in_hot_loop(tmp_path):
         ["Sched._dispatch", "Sched._loop", "Sched._loop"]
     assert all(f.rule == "JG006" for f in found)
     assert any("float()" in f.message for f in found)
+
+
+def test_jg007_swallowed_exception_in_thread(tmp_path):
+    """True positives: bare/overbroad except handlers inside the
+    Thread-target call graph that neither re-raise nor use the caught
+    exception — the scheduler-loop-death-hider. True negatives: narrow
+    catches, re-raises, handlers that consume the exception (failing a
+    future with it), and identical handlers OUTSIDE thread code."""
+    src = """
+    import threading
+
+    class Sched:
+        def start(self):
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                try:
+                    self._step()
+                except:            # TP: bare, swallows
+                    pass
+                try:
+                    self._step()
+                except Exception:  # TP: overbroad, swallows
+                    continue
+                self._helper()
+                self._ok_paths()
+
+        def _helper(self):
+            try:
+                self._step()
+            except BaseException as e:  # TP: bound but never used
+                self.count += 1
+
+        def _ok_paths(self):
+            try:
+                self._step()
+            except ValueError:     # TN: narrow catch
+                pass
+            try:
+                self._step()
+            except Exception:      # TN: re-raises
+                raise
+            try:
+                self._step()
+            except Exception as e:  # TN: the exception is consumed
+                self.future._fail(e)
+            try:
+                self._step()
+            except Exception:      # TN: suppressed with rationale  # graftlint: disable=JG007
+                pass
+
+        def _step(self):
+            return 1
+
+    def cold_path():
+        try:
+            return 2
+        except Exception:  # TN: not in any Thread-target call graph
+            pass
+    """
+    found = _lint(tmp_path, src, [SwallowedExceptionInThread()])
+    assert sorted(f.symbol for f in found) == \
+        ["Sched._helper", "Sched._loop", "Sched._loop"]
+    assert all(f.rule == "JG007" for f in found)
 
 
 # ----------------------------------------------------- concurrency rules --

@@ -1,0 +1,464 @@
+"""Engine supervisor + failpoint unit tests (ISSUE 7 satellites).
+
+The timing-sensitive machinery is tested with a FROZEN injectable clock
+and zero real sleeps: the watchdog's hang verdict, the warm-up grace,
+and the degradation ladder are all pure functions of (clock, heartbeat,
+queue depth) driven through `check()` on stub engines. The pieces that
+need a real engine (retry-budget 503 over HTTP, drain completing
+in-flight work, the stop()-races-POST regression) use the smallest LM
+that exercises the full path. Failpoint trigger determinism — same
+seed, same trigger sequence — is what makes chaos runs replayable.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.inference import (DecodeScheduler, EngineSupervisor,
+                                          MetricsRegistry,
+                                          RetryBudgetExceededError,
+                                          failpoints)
+from deeplearning4j_tpu.inference.failpoints import parse_spec
+from deeplearning4j_tpu.inference.supervisor import AdmissionRejectedError
+from deeplearning4j_tpu.inference.trace import FlightRecorder
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+V = 13
+
+
+def _lm(cache=96):
+    conf = transformer_lm(vocab_size=V, d_model=16, n_heads=2, n_blocks=2,
+                          rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = cache
+    return ComputationGraph(conf).init()
+
+
+class FakeClock:
+    """Frozen time: advances only when told (or when fake-sleeping)."""
+
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, s):
+        self.now += s
+
+
+class StubEngine:
+    """The narrow surface EngineSupervisor drives, with settable vitals.
+    No threads, no device, no sleeps — watchdog verdicts become pure
+    functions of the fake clock."""
+
+    def __init__(self, clock):
+        self._clock = clock
+        self.heartbeat = clock()
+        self.iterations = 1  # past warm-up by default
+        self.crashed = None
+        self.fenced = False
+        self.stopped = False
+        self.prefill_chunk = 64
+        self.chunk_cap = None
+        self.max_queue = 64
+        self._queue_depth = 0
+        self.shed_calls = []
+        self._thread = None
+        self._on_crash = None
+        self.submitted = []
+
+    def fence(self):
+        self.fenced = True
+
+    def stop(self):
+        self.stopped = True
+
+    def start(self):
+        return self
+
+    def inflight(self):
+        return self._queue_depth
+
+    def queue_depth(self):
+        return self._queue_depth
+
+    def shed_queued(self, target):
+        self.shed_calls.append(target)
+        return 0
+
+    def submit(self, prompt, max_new_tokens, **kw):
+        self.submitted.append((list(prompt), max_new_tokens, kw))
+        handle = kw.get("_handle")
+        if handle is None:
+            from deeplearning4j_tpu.inference.engine import DecodeHandle
+            handle = DecodeHandle(len(prompt), max_new_tokens)
+        return handle
+
+
+def _stub_supervisor(clock, **kw):
+    spawned = []
+
+    def factory():
+        eng = StubEngine(clock)
+        spawned.append(eng)
+        return eng
+
+    sup = EngineSupervisor(factory, clock=clock, sleep_fn=clock.sleep,
+                           watchdog=False, warm_on_build=False,
+                           metrics=MetricsRegistry(),
+                           tracer=FlightRecorder(1024), **kw)
+    return sup, spawned
+
+
+# ------------------------------------------------- watchdog, frozen clock --
+def test_watchdog_hang_detection_timing_no_real_sleeps():
+    """The hang verdict is exactly `age > hang_timeout_s`: one second
+    under the threshold is healthy, one over trips recovery — proven by
+    stepping a frozen clock, with zero wall-clock sleeping."""
+    clock = FakeClock()
+    sup, spawned = _stub_supervisor(clock, hang_timeout_s=5.0,
+                                    backoff_base_s=0.0)
+    eng = sup.engine
+    eng.heartbeat = clock()
+    clock.now += 4.9  # under threshold: no restart
+    sup.check()
+    assert sup.restarts == 0 and sup.engine is eng and sup.ready
+    clock.now += 0.2  # age 5.1 > 5.0: hang declared
+    sup.check()
+    assert sup.restarts == 1
+    assert eng.fenced, "the dead engine must be fenced before reuse"
+    assert sup.engine is not eng and len(spawned) == 2
+    assert sup.ready  # fresh engine, fresh heartbeat
+    sup.stop()
+
+
+def test_watchdog_warmup_grace_for_fresh_engines():
+    """An engine that has not completed its first iteration (XLA still
+    compiling) is judged by warmup_timeout_s, not hang_timeout_s — a
+    rebuilt engine's first-call compiles must not read as a fresh hang."""
+    clock = FakeClock()
+    sup, _ = _stub_supervisor(clock, hang_timeout_s=1.0,
+                              warmup_timeout_s=30.0, backoff_base_s=0.0)
+    eng = sup.engine
+    eng.iterations = 0  # never completed an iteration: warming
+    eng.heartbeat = clock()
+    clock.now += 10.0  # way past hang_timeout, inside warmup budget
+    sup.check()
+    assert sup.restarts == 0 and sup.engine is eng
+    clock.now += 25.0  # past even the warmup budget: genuinely stuck
+    sup.check()
+    assert sup.restarts == 1
+    sup.stop()
+
+
+def test_crash_recovery_resubmits_with_backoff_and_budget():
+    """A crashed engine's tracked requests are resubmitted (front of
+    queue, original handle) on a rebuilt engine; consecutive restarts
+    back off exponentially; the retry budget converts the N-th failure
+    into RetryBudgetExceededError on the handle — never silence."""
+    clock = FakeClock()
+    sup, spawned = _stub_supervisor(clock, hang_timeout_s=5.0,
+                                    retry_budget=3, backoff_base_s=0.1,
+                                    backoff_max_s=10.0, backoff_jitter=0.0)
+    h = sup.submit([1, 2, 3], 4, seed=7)
+    for expected_attempts in (2, 3):
+        sup.engine.crashed = RuntimeError("boom")
+        t_before = clock()
+        sup.check()
+        assert sup.restarts == expected_attempts - 1
+        new_eng = sup.engine
+        assert new_eng.submitted, "request must be resubmitted"
+        prompt, mnt, kw = new_eng.submitted[-1]
+        assert (prompt, mnt) == ([1, 2, 3], 4)
+        assert kw.get("_handle") is h and kw.get("_front") is True
+        assert kw.get("seed") == 7, "same seed = token-identical re-run"
+        assert h.retries == expected_attempts - 1
+        # exponential backoff: 0.1 * 2^streak fake-slept on the clock
+        assert clock() - t_before == pytest.approx(
+            0.1 * 2 ** (expected_attempts - 2))
+    # third crash: attempts (3) >= budget (3) -> abandoned, structured
+    sup.engine.crashed = RuntimeError("boom")
+    sup.check()
+    with pytest.raises(RetryBudgetExceededError) as ei:
+        h.result(0)
+    assert ei.value.request_id == h.request_id
+    assert sup.metrics.counter("requests_abandoned_total").value == 1
+    sup.stop()
+
+
+def test_degradation_ladder_escalates_and_recovers():
+    """Sustained pressure walks shed -> halve-chunk -> reject (with
+    Retry-After); sustained calm walks back down. Driven entirely by
+    fake queue depths through check()."""
+    clock = FakeClock()
+    sup, _ = _stub_supervisor(clock, hang_timeout_s=1e9,
+                              ladder_patience=2)
+    eng = sup.engine
+    eng._queue_depth = 60  # 60/64 > 0.75: pressure
+    for level in (1, 2, 3):
+        sup.check()
+        sup.check()
+        assert sup.degradation_level == level
+    assert sup.metrics.gauge("degradation_level").value == 3
+    # L1+: queued load above half the queue is shed
+    assert eng.shed_calls and eng.shed_calls[-1] == eng.max_queue // 2
+    # L2+: prefill chunk cap halved (smaller buckets already compiled)
+    assert eng.chunk_cap == eng.prefill_chunk // 2
+    # L3: admission refused with a Retry-After hint
+    with pytest.raises(AdmissionRejectedError) as ei:
+        sup.submit([1], 1)
+    assert ei.value.retry_after_s > 0
+    # calm walks back down to 0 and the chunk cap lifts
+    eng._queue_depth = 2
+    for level in (2, 1, 0):
+        sup.check()
+        sup.check()
+        assert sup.degradation_level == level
+    assert eng.chunk_cap is None
+    sup.stop()
+
+
+def test_degradation_level_survives_engine_restart():
+    clock = FakeClock()
+    sup, _ = _stub_supervisor(clock, hang_timeout_s=1e9,
+                              ladder_patience=1, backoff_base_s=0.0)
+    sup.engine._queue_depth = 60
+    sup.check()
+    sup.check()
+    assert sup.degradation_level == 2
+    sup.engine.crashed = RuntimeError("boom")
+    sup.check()
+    assert sup.engine.chunk_cap == sup.engine.prefill_chunk // 2, \
+        "a restart under pressure must come up degraded, not amnesiac"
+    sup.stop()
+
+
+# ------------------------------------------------- failpoint determinism --
+def test_failpoint_probability_is_seed_deterministic():
+    """Same seed -> the exact same trigger sequence over N hits (what
+    makes a chaos run replayable); a different seed diverges."""
+
+    def sequence(seed, n=200):
+        failpoints.arm("dispatch.decode", f"crash@p:0.3:{seed}")
+        out = []
+        for _ in range(n):
+            try:
+                failpoints.fire("dispatch.decode")
+                out.append(0)
+            except failpoints.InjectedCrash:
+                out.append(1)
+        failpoints.disarm("dispatch.decode")
+        return out
+
+    a, b, c = sequence(7), sequence(7), sequence(8)
+    assert a == b, "same seed must replay the same trigger sequence"
+    assert a != c, "different seeds must diverge"
+    assert 0 < sum(a) < len(a)  # actually probabilistic, not all/none
+
+
+def test_failpoint_triggers_nth_hit_and_once():
+    failpoints.arm("dispatch.prefill", "oom@n:3")
+    hits = []
+    for _ in range(5):
+        try:
+            failpoints.fire("dispatch.prefill")
+            hits.append(0)
+        except failpoints.InjectedOOM:
+            hits.append(1)
+    failpoints.disarm()
+    assert hits == [0, 0, 1, 0, 0]
+    failpoints.arm("http.handler", "crash")  # default trigger: once
+    with pytest.raises(failpoints.InjectedCrash):
+        failpoints.fire("http.handler")
+    failpoints.fire("http.handler")  # second hit: already spent
+    failpoints.disarm()
+
+
+def test_failpoint_spec_errors_fail_arming_loudly():
+    for bad in ("explode", "hang", "hang:", "crash@n:0", "crash@p:1.5",
+                "crash@sometimes"):
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+    with pytest.raises(ValueError):
+        failpoints.arm("no.such.seam", "crash")
+    assert failpoints.snapshot() == {}
+
+
+def test_disarmed_fire_is_free_and_silent():
+    # the production hot path: nothing armed, nothing happens
+    for seam in failpoints.SEAMS:
+        failpoints.fire(seam)
+
+
+# ----------------------------------------------- real engine: drain, 503s --
+@pytest.fixture(scope="module")
+def lm_net():
+    return _lm()
+
+
+def test_drain_completes_inflight_then_flips_ready(lm_net):
+    """/admin/drain semantics at the supervisor level: admission stops
+    (ready False), the in-flight request still finishes COMPLETELY on
+    the old engine, then a fresh engine swaps in and ready flips back."""
+    sup = EngineSupervisor(
+        lambda: DecodeScheduler(lm_net, V, n_slots=2, prefill_chunk=16,
+                                metrics=MetricsRegistry()),
+        hang_timeout_s=30.0, poll_interval_s=0.02,
+        metrics=MetricsRegistry(), tracer=FlightRecorder(2048))
+    try:
+        old = sup.engine
+        h = sup.submit(list(range(1, 9)), 12, seed=1)
+        seen_unready = []
+
+        def watch():
+            while sup._draining:
+                seen_unready.append(sup.ready)
+                time.sleep(0.005)
+
+        watcher = threading.Thread(target=watch)
+        drainer = threading.Thread(target=lambda: sup.drain(timeout=120))
+        drainer.start()
+        watcher.start()
+        drainer.join(timeout=120)
+        watcher.join(timeout=5)
+        assert not drainer.is_alive()
+        assert len(h.result(5)) == 12, "in-flight work completed in full"
+        assert sup.engine is not old, "engine swapped"
+        assert old.inflight() == 0
+        assert all(r is False for r in seen_unready), \
+            "ready must be False for the whole drain window"
+        deadline = time.monotonic() + 30
+        while not sup.ready and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sup.ready, "ready flips back after the swap"
+        # drained-in engine passes its compile budgets (warmed, no storm)
+        assert sup.engine._compile_counter.check() == []
+    finally:
+        sup.stop()
+
+
+def test_retry_budget_exhaustion_is_http_503_not_silence(lm_net):
+    """The acceptance wording: exhaustion returns a STRUCTURED 503
+    carrying the request_id — through the real HTTP stack. The seam is
+    armed only once the request is IN FLIGHT, so it is deterministically
+    admitted first and then sees every subsequent attempt crash."""
+    from deeplearning4j_tpu.serving import InferenceServer
+    srv = InferenceServer(net=lm_net, decode_vocab=V, decode_slots=2,
+                          prefill_chunk=16, hang_timeout_s=30.0,
+                          retry_budget=2).start()
+    srv.supervisor.poll_interval_s = 0.02
+    srv.supervisor.backoff_base_s = 0.01
+    srv.supervisor.backoff_max_s = 0.05
+    results = []
+
+    def request():
+        body = json.dumps({"prompt": list(range(1, 7)),
+                           "max_new_tokens": 80}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=120)
+            results.append(("ok", None))
+        except urllib.error.HTTPError as e:
+            results.append((e.code, json.loads(e.read())))
+
+    th = threading.Thread(target=request)
+    th.start()
+    try:
+        deadline = time.monotonic() + 60
+        while srv.supervisor.engine.inflight() == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        failpoints.arm("scheduler.iteration", "crash@always")
+        th.join(timeout=120)
+        assert not th.is_alive(), "exhaustion must ANSWER, not hang"
+    finally:
+        failpoints.disarm()
+        srv.stop()
+        th.join(timeout=10)
+    code, payload = results[0]
+    assert code == 503, (code, payload)
+    assert payload["error"] == "retry_budget_exhausted"
+    assert payload["request_id"]
+    assert srv.metrics.counter("requests_abandoned_total").value >= 1
+
+
+def test_stop_racing_inflight_post_fails_fast_with_503(lm_net):
+    """Regression (ISSUE 7 satellite): InferenceServer.stop() while a
+    POST /generate is mid-decode used to leave the request hanging
+    until its full timeout; now it answers a structured 503
+    ("shutting_down", request_id echoed) promptly."""
+    from deeplearning4j_tpu.serving import InferenceServer
+    srv = InferenceServer(net=lm_net, decode_vocab=V, decode_slots=1,
+                          prefill_chunk=16, hang_timeout_s=30.0).start()
+    # wedge the decode mid-request so it CANNOT finish before teardown
+    # (the race this regression pins: stop() vs a request that will not
+    # complete on its own; the watchdog is too slow to matter here)
+    failpoints.arm("dispatch.decode", "hang:2500@n:5")
+    results = []
+
+    def long_request():
+        body = json.dumps({"prompt": list(range(1, 7)),
+                           "max_new_tokens": 60}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=120)
+            results.append(("ok", None))
+        except urllib.error.HTTPError as e:
+            results.append((e.code, json.loads(e.read())))
+        except Exception as e:  # noqa: BLE001 - recorded for the assert
+            results.append(("neterr", repr(e)))
+
+    th = threading.Thread(target=long_request)
+    th.start()
+    try:
+        # wait until the decode is actually in flight, then yank the
+        # server out from under it
+        deadline = time.monotonic() + 60
+        while srv.supervisor.engine.inflight() == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        srv.stop()
+        th.join(timeout=30)
+        elapsed = time.monotonic() - t0
+    finally:
+        failpoints.disarm()
+    assert not th.is_alive(), "handler thread must not hang"
+    assert elapsed < 20, f"teardown answered too slowly ({elapsed:.1f}s)"
+    assert results, "the client must receive SOME response"
+    code, payload = results[0]
+    assert code == 503, (code, payload)
+    assert payload["error"] == "shutting_down"
+    assert payload["request_id"]
+
+
+def test_shutting_down_flag_rejects_new_posts(lm_net):
+    """A POST that arrives after stop() began (but before the socket
+    closes) gets the structured 503, not a hang or a stack trace."""
+    from deeplearning4j_tpu.serving import InferenceServer
+    srv = InferenceServer(net=lm_net).start()
+    port = srv.port
+    srv._shutting_down = True  # the first thing stop() sets
+    try:
+        body = json.dumps({"data": [[0.0] * 4]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["error"] == "shutting_down"
+    finally:
+        srv.stop()
