@@ -614,6 +614,139 @@ def bench_trace_overhead(prompt_len=64, new_tokens=24, chunk=32, vocab=64,
     }
 
 
+def bench_race_audit(prompt_len=64, new_tokens=24, chunk=32, vocab=64,
+                     n_reqs=6, rounds=8, d_model=128) -> dict:
+    """Race-checker shim cost A/B (ISSUE 8 acceptance: the DISARMED
+    tracer must cost <= 2% on the decode hot loop). Two identical decode
+    schedulers drive the same prompts: the plain one is built with real
+    primitives; the shimmed one is built INSIDE a `race_audit` window,
+    so its condvar/locks/threads carry the vector-clock instrumentation
+    — but nothing is ever `watch()`ed, which is exactly the state a
+    production-adjacent soak run would keep permanently. Interleaved
+    best-of-``rounds``, same protocol as trace_overhead. Also measures
+    the raw per-lock-op shim cost. Standalone-runnable:
+        python -c "import bench, json; print(json.dumps(bench.bench_race_audit()))"
+    """
+    import threading as _threading
+
+    from deeplearning4j_tpu.analysis.races import race_audit
+    from deeplearning4j_tpu.inference import DecodeScheduler, MetricsRegistry
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    # d128 (not the d64 the other serving benches use): the per-
+    # iteration shim overhead is FIXED (~a dozen sub-us lock hooks), so
+    # judging a <=2% budget against a sub-millisecond toy step would
+    # measure the toy, not the checker; d128 puts the step in the
+    # realistic-model regime the budget is actually about
+    conf = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = prompt_len + new_tokens
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(5)
+    prompts = [list(rng.integers(0, vocab, prompt_len))
+               for _ in range(n_reqs)]
+
+    def make():
+        return DecodeScheduler(net, vocab, n_slots=4, prefill_chunk=chunk,
+                               metrics=MetricsRegistry()).start()
+
+    def warm(eng):
+        for h in [eng.submit(p, 2) for p in prompts]:  # warm/compile
+            h.result(600)
+        return eng
+
+    def run_once(eng):
+        t0 = time.perf_counter()
+        for h in [eng.submit(p, new_tokens) for p in prompts]:
+            h.result(600)
+        return n_reqs * new_tokens / (time.perf_counter() - t0)
+
+    eng_plain = warm(make())  # real primitives throughout
+    # the shimmed engine's condvar/locks/scheduler thread are built under
+    # the audit window; after the `with` exits the GLOBAL constructors are
+    # restored while the shimmed engine keeps its vector-clock-carrying
+    # primitives — the persistent "armed shims, disarmed attribute
+    # tracer" state under test. Warm-up (XLA compiles) runs AFTER exit:
+    # what is measured is the engine's own shimmed primitives, not
+    # incidentally-wrapped jax-internal cache locks allocated mid-compile.
+    with race_audit():
+        eng_shim = make()
+    warm(eng_shim)
+    def step_state(eng):
+        h = eng.metrics.histogram("decode_step_time_sec")
+        s = h.snapshot()
+        return (s.get("count", 0), s.get("sum", 0.0))
+
+    try:
+        # the FLOOR metric is the scheduler's own per-iteration step
+        # time (decode_step_time_sec), pooled mean over every TIMED
+        # iteration of every round (symmetric across engines; warm-
+        # phase steps excluded — they ran at different process ages):
+        # the <=2% budget is a claim about the decode HOT LOOP, and
+        # end-to-end wall time folds in submit-side jitter and handle
+        # waits that best-of-N cannot fully wash out (a null A/B of
+        # two plain engines still spreads ~2% on wall time)
+        base_plain, base_shim = step_state(eng_plain), step_state(eng_shim)
+        tps_plain = tps_shim = 0.0
+        for _ in range(rounds):  # interleaved A/B (host-drift-fair)
+            tps_plain = max(tps_plain, run_once(eng_plain))
+            tps_shim = max(tps_shim, run_once(eng_shim))
+
+        def timed_mean(eng, base):
+            n, s = step_state(eng)
+            return (s - base[1]) / max(1, n - base[0])
+
+        mean_plain = timed_mean(eng_plain, base_plain)
+        mean_shim = timed_mean(eng_shim, base_shim)
+    finally:
+        eng_plain.stop()
+        eng_shim.stop()
+    # raw shim cost per lock round-trip (the unit the ratio is built of;
+    # the context is entered only for its constructor patch)
+    with race_audit():
+        shim_lock = _threading.Lock()
+    real_lock = _threading.Lock()
+    n_ops = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_ops):
+        with real_lock:
+            pass
+    t_real = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n_ops):
+        with shim_lock:
+            pass
+    t_shim = time.perf_counter() - t0
+    return {
+        "tokens_per_sec_plain": round(tps_plain, 1),
+        "tokens_per_sec_shimmed": round(tps_shim, 1),
+        "wall_throughput_ratio": round(tps_shim / tps_plain, 4),
+        "step_ms_plain": round(mean_plain * 1e3, 4),
+        "step_ms_shimmed": round(mean_shim * 1e3, 4),
+        "step_time_ratio": round(mean_plain / mean_shim, 4),
+        # no "violations" field on purpose: this bench never watch()es
+        # anything (it measures the DISARMED state), so a violation
+        # count would be vacuously zero and gate nothing — the real
+        # zero-violations assertions live in tests/test_lint_clean.py
+        # and tests/test_chaos.py where state is actually watched
+        "lock_roundtrip_ns_real": round(1e9 * t_real / n_ops),
+        "lock_roundtrip_ns_shimmed": round(1e9 * t_shim / n_ops),
+        "note": f"{n_reqs} concurrent {prompt_len}-token prompts x "
+                f"{new_tokens} greedy tokens on a 2-block d{d_model} LM, "
+                "4 slots; shimmed = engine built under race_audit "
+                "(vector-clock locks/condvar/thread, ZERO watched "
+                "objects — the disarmed attribute tracer), plain = real "
+                f"primitives; best-of-{rounds} interleaved rounds. "
+                "Floor: step_time_ratio (plain/shimmed mean scheduler-"
+                "iteration time over the timed phase) >= 0.98, the <=2% "
+                "disarmed-checker budget on the decode hot loop",
+    }
+
+
 def bench_chaos_recovery(prompt_len=48, new_tokens=16, chunk=16, vocab=64,
                          n_reqs=6, max_waves=40, crash_p=0.01) -> dict:
     """Fault-tolerance cost A/B (ISSUE 7): the SAME supervised decode
@@ -1212,6 +1345,12 @@ def main() -> None:
         WORKLOADS["chaos_recovery"] = bench_chaos_recovery()
     except Exception as e:
         WORKLOADS["chaos_recovery"] = {"error": str(e)}
+
+    # ---- analysis: race-checker disarmed-shim-cost A/B (ISSUE 8) --------
+    try:
+        WORKLOADS["race_audit"] = bench_race_audit()
+    except Exception as e:
+        WORKLOADS["race_audit"] = {"error": str(e)}
 
     # ---- perf-regression gate vs committed floors (BENCH_FLOORS.json) ----
     regressions = check_floors(WORKLOADS)

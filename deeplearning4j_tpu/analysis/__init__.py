@@ -6,25 +6,33 @@ states in general form: jitted program families must not silently grow
 host<->device syncs, and the threaded modules must not deadlock. This
 package machine-enforces them, twice over:
 
-  - **statically** (`core`, `jax_rules`, `concurrency_rules`, `lint`): an
-    AST linter with a JAX rule pack (host syncs in traced/hot code, Python
-    branches on tracers, jit closing over mutable globals, missing
-    static_argnums, impure calls under trace) and a concurrency rule pack
-    (lock-acquisition-order graph with cycle detection, blocking calls
-    under a lock, `Condition.wait` outside a predicate loop, torn
-    reads of lock-guarded state). Findings diff against a committed
-    baseline (`baseline.json`) so CI fails on *new* violations only;
-    inline `# graftlint: disable=RULE` suppressions are honored.
-  - **at runtime** (`runtime`): a `CompileCounter` asserting
+  - **statically** (`core`, `jax_rules`, `concurrency_rules`, `races`,
+    `lint`): an AST linter with a JAX rule pack (host syncs in traced/hot
+    code, Python branches on tracers, jit closing over mutable globals,
+    missing static_argnums, impure calls under trace), a concurrency rule
+    pack (lock-acquisition-order graph with cycle detection, blocking
+    calls under a lock, `Condition.wait` outside a predicate loop, torn
+    reads of lock-guarded state), and an Eraser-style lockset race pass
+    (CC005/CC006: shared state touched from two thread sides with no
+    common lock and no sanctioned Queue/Event/start/join/count
+    happens-before channel). Findings diff against a committed baseline
+    (`baseline.json`, every entry justified) so CI fails on *new*
+    violations only; inline `# graftlint: disable=RULE` suppressions are
+    honored.
+  - **at runtime** (`runtime`, `races`): a `CompileCounter` asserting
     jit-program-count budgets, a `jax.transfer_guard`-based
-    device-residency mode with an allow-listed `host_read` boundary, and
-    an instrumented-lock audit that records real acquisition orders and
-    cross-checks them against the static lock graph.
+    device-residency mode with an allow-listed `host_read` boundary, an
+    instrumented-lock audit that records real acquisition orders and
+    cross-checks them against the static lock graph, and a FastTrack-lite
+    vector-clock happens-before checker (`race_audit`) whose opt-in
+    attribute tracer proves watched engine/supervisor/metrics state is
+    ordered by the locks and channels the static pass credits.
 
 CLI: ``python -m deeplearning4j_tpu.analysis.lint`` (or the ``graftlint``
 console script). Docs: ``docs/static_analysis.md``.
 """
 from .core import Baseline, Finding, Linter, ModuleInfo, Rule, load_modules
+from .races import RaceDetector, VectorClock, race_audit
 from .runtime import (CompileCounter, LockAuditor, crosscheck_lock_order,
                       device_index, device_residency, host_read, lock_audit)
 
@@ -32,7 +40,9 @@ __all__ = [
     "Baseline", "Finding", "Linter", "ModuleInfo", "Rule", "load_modules",
     "CompileCounter", "LockAuditor", "crosscheck_lock_order",
     "device_index", "device_residency", "host_read", "lock_audit",
+    "RaceDetector", "VectorClock", "race_audit",
     "all_rules", "jax_rule_pack", "concurrency_rule_pack",
+    "race_rule_pack",
 ]
 
 
@@ -46,5 +56,10 @@ def concurrency_rule_pack():
     return [r() for r in RULES]
 
 
+def race_rule_pack():
+    from .races import RULES
+    return [r() for r in RULES]
+
+
 def all_rules():
-    return jax_rule_pack() + concurrency_rule_pack()
+    return jax_rule_pack() + concurrency_rule_pack() + race_rule_pack()

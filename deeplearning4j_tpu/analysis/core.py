@@ -241,13 +241,24 @@ class Baseline:
         return cls(entries=data.get("findings", {}))
 
     @classmethod
-    def from_findings(cls, findings: Sequence[Finding]) -> "Baseline":
+    def from_findings(cls, findings: Sequence[Finding],
+                      prior: Optional["Baseline"] = None) -> "Baseline":
+        """Build a ledger from current findings. ``prior``: the previous
+        ledger — entries that survive keep their reviewed
+        ``justification`` text, so regenerating the baseline never
+        silently discards the rationale a reviewer wrote for accepting
+        the debt (new entries get an explicit TODO marker instead)."""
         entries: Dict[str, dict] = {}
         for f in findings:
             e = entries.setdefault(f.fingerprint, {
                 "rule": f.rule, "path": f.path, "symbol": f.symbol,
                 "message": f.message, "snippet": f.snippet, "count": 0})
             e["count"] += 1
+        for fp, e in entries.items():
+            old = prior.entries.get(fp) if prior is not None else None
+            e["justification"] = (old or {}).get(
+                "justification",
+                "TODO: reviewed-by + why this debt is accepted")
         return cls(entries=entries)
 
     def save(self, path: Path) -> None:

@@ -630,21 +630,33 @@ class ImpureInJit(_JaxRule):
         return out
 
 
+def thread_spawn_sites(idx: _FnIndex
+                       ) -> List[Tuple[Optional[str], Optional[ast.AST],
+                                       ast.AST]]:
+    """(enclosing class, spawning def node, target def node) for every
+    ``threading.Thread/Timer(target=...)`` call in the module — the seed
+    set shared by JG006/JG007's hot-loop walker and the CC005/CC006
+    lockset race pass (analysis.races)."""
+    out = []
+    for cls, scope, call in idx._calls():
+        d = _dotted(call.func)
+        if not d or d.split(".")[-1] not in ("Thread", "Timer"):
+            continue
+        for kw in call.keywords:
+            if kw.arg != "target":
+                continue
+            for target in idx._resolve(cls, scope, kw.value):
+                out.append((cls, scope, target))
+    return out
+
+
 def _thread_target_functions(idx: _FnIndex
                              ) -> List[Tuple[Optional[str], ast.AST]]:
     """Thread-target functions plus everything they call in-module: the
     code that runs on a dispatcher/scheduler thread's loop. Shared by
     JG006 (host syncs stall the loop) and JG007 (swallowed exceptions
     hide the loop's death)."""
-    seeds: Set[int] = set()
-    for cls, scope, call in idx._calls():
-        d = _dotted(call.func)
-        if not d or d.split(".")[-1] != "Thread":
-            continue
-        for kw in call.keywords:
-            if kw.arg == "target":
-                for target in idx._resolve(cls, scope, kw.value):
-                    seeds.add(id(target))
+    seeds: Set[int] = {id(t) for _, _, t in thread_spawn_sites(idx)}
     if not seeds:
         return []
     id2 = {}

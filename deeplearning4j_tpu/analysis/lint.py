@@ -2,13 +2,20 @@
 
     python -m deeplearning4j_tpu.analysis.lint [paths...]
         [--format text|json] [--baseline FILE] [--update-baseline]
-        [--no-baseline] [--rules JG001,CC004,...]
+        [--no-baseline] [--select JG001,CC005,...] [--ignore CC004,...]
 
 Defaults: paths = the installed ``deeplearning4j_tpu`` package directory,
 baseline = the committed ``analysis/baseline.json``. Exit codes: 0 clean
 (every finding baselined or none), 1 new violations (or parse errors),
 2 usage error. ``--update-baseline`` rewrites the ledger from the current
 findings and exits 0 — the reviewed-diff workflow for accepting debt.
+
+``--select`` runs ONLY the named rules and ``--ignore`` drops the named
+rules from whatever is selected — that is how CI gates a NEW rule
+independently of the committed baseline (``--select CC005,CC006
+--no-baseline`` must exit 0 before the rule is allowed to gate), and how
+an emergency revert mutes one rule (``--ignore CC005``) without touching
+the ledger. ``--rules`` is the legacy spelling of ``--select``.
 """
 from __future__ import annotations
 
@@ -24,12 +31,25 @@ from .core import Baseline, Linter
 _DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 _DEFAULT_TARGET = Path(__file__).resolve().parent.parent  # the package
 
+_EXIT_DOC = """exit codes:
+  0  clean — no finding beyond the committed baseline (or none at all)
+  1  new violations, or files the analyzer could not parse
+  2  usage error (conflicting flags, unknown rule ids)
+
+rule packs: JG001-JG007 (JAX trace/hot-loop discipline), CC001-CC004
+(lock ordering/atomicity), CC005-CC006 (lockset data-race detection).
+To accept a finding deliberately: annotate the line
+`# graftlint: disable=<RULE>` with a rationale, or re-run with
+--update-baseline and commit the reviewed ledger diff."""
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="graftlint",
         description="JAX-aware static analyzer: recompile discipline, "
-                    "host-sync hygiene, lock ordering")
+                    "host-sync hygiene, lock ordering, data races",
+        epilog=_EXIT_DOC,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("paths", nargs="*", type=Path,
                    default=None, help="files/dirs to lint "
                    "(default: the deeplearning4j_tpu package)")
@@ -39,26 +59,42 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-baseline", action="store_true",
                    help="report every finding, ignore the ledger")
     p.add_argument("--update-baseline", action="store_true",
-                   help="rewrite the ledger from current findings")
-    p.add_argument("--rules", default=None,
-                   help="comma-separated rule ids to run (default: all)")
+                   help="rewrite the ledger from current findings "
+                        "(justifications of surviving entries carry over)")
+    p.add_argument("--select", "--rules", dest="select", default=None,
+                   help="comma-separated rule ids to run (default: all); "
+                        "--rules is the legacy spelling")
+    p.add_argument("--ignore", default=None,
+                   help="comma-separated rule ids to drop from the "
+                        "selection (applied after --select)")
     return p
 
 
 def run_lint(paths: Optional[Sequence[Path]] = None,
-             rules: Optional[Sequence[str]] = None):
+             rules: Optional[Sequence[str]] = None,
+             ignore: Optional[Sequence[str]] = None):
     """(findings, errors) over the given paths — the programmatic entry
-    the CI gate test uses. Unknown rule ids raise (a typo'd --rules must
-    not produce a vacuously clean run)."""
+    the CI gate test uses. Unknown rule ids raise (a typo'd --select /
+    --ignore must not produce a vacuously clean run)."""
     selected = all_rules()
+    known = {r.id for r in selected}
     if rules:
-        wanted = {r.strip() for r in rules}
-        known = {r.id for r in selected}
+        wanted = {r.strip() for r in rules if r.strip()}
         unknown = sorted(wanted - known)
         if unknown:
             raise ValueError(
                 f"unknown rule id(s) {unknown}; known: {sorted(known)}")
         selected = [r for r in selected if r.id in wanted]
+    if ignore:
+        dropped = {r.strip() for r in ignore if r.strip()}
+        unknown = sorted(dropped - known)
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s) {unknown}; known: {sorted(known)}")
+        selected = [r for r in selected if r.id not in dropped]
+    if not selected:
+        raise ValueError("rule selection is empty (--select minus "
+                         "--ignore left nothing to run)")
     linter = Linter(selected)
     return linter.run(list(paths) if paths else [_DEFAULT_TARGET])
 
@@ -69,28 +105,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("--update-baseline and --no-baseline conflict",
               file=sys.stderr)
         return 2
-    if args.update_baseline and args.rules:
+    if args.update_baseline and (args.select or args.ignore):
         # a rules-subset run sees a subset of findings; rewriting the
         # ledger from it would silently retire every other rule's entries
-        print("--update-baseline requires a full-rule run (drop --rules)",
-              file=sys.stderr)
+        print("--update-baseline requires a full-rule run (drop "
+              "--select/--ignore)", file=sys.stderr)
         return 2
     if args.update_baseline and args.paths and args.baseline is None:
         print("--update-baseline over a custom path set would overwrite "
               "the default package ledger with partial findings; pass an "
               "explicit --baseline for it", file=sys.stderr)
         return 2
-    rules = args.rules.split(",") if args.rules else None
+    rules = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
     paths = args.paths if args.paths else None
     try:
-        findings, errors = run_lint(paths, rules)
-    except ValueError as e:  # typo'd --rules: refuse, don't pass cleanly
+        findings, errors = run_lint(paths, rules, ignore)
+    except ValueError as e:  # typo'd --select/--ignore: refuse
         print(str(e), file=sys.stderr)
         return 2
 
     baseline_path = args.baseline or _DEFAULT_BASELINE
     if args.update_baseline:
-        Baseline.from_findings(findings).save(baseline_path)
+        prior = Baseline.load(baseline_path)
+        Baseline.from_findings(findings, prior=prior).save(baseline_path)
         print(f"baseline updated: {len(findings)} finding(s) -> "
               f"{baseline_path}")
         return 0

@@ -327,12 +327,18 @@ class _AuditedCondition(threading.Condition):
 
 
 @contextlib.contextmanager
-def lock_audit():
+def lock_audit(auditor: Optional[LockAuditor] = None):
     """Patch threading's lock constructors so every lock allocated inside
     the context is instrumented; yields the LockAuditor. Locks created
     BEFORE entry keep their real, unobserved implementations — construct
-    the objects under audit inside the context."""
-    auditor = LockAuditor()
+    the objects under audit inside the context.
+
+    ``auditor``: a LockAuditor (sub)instance to drive — the runtime race
+    checker (`analysis.races.race_audit`) passes one whose
+    acquire/release hooks additionally merge vector clocks, so the SAME
+    instrumented-lock machinery feeds both the lock-order cross-check
+    and the happens-before partial order."""
+    auditor = LockAuditor() if auditor is None else auditor
     real_lock, real_rlock = threading.Lock, threading.RLock
     real_cond = threading.Condition
 
@@ -343,7 +349,16 @@ def lock_audit():
         return _AuditedLock(auditor, real_rlock())
 
     def make_cond(lock=None):
-        return _AuditedCondition(auditor, lock)
+        # a bare Condition() must get a REAL inner RLock, not the
+        # patched constructor: _AuditedCondition's own overrides are the
+        # instrumentation point, and letting Condition.__init__ call the
+        # patched RLock() would double-wrap every condvar operation
+        # (Python-level acquire + __getattr__ fallbacks for
+        # _is_owned/_release_save on the wrapper — measured ~6x the
+        # native cost on the decode hot loop) while contributing only
+        # self-edges to the order graph
+        return _AuditedCondition(auditor,
+                                 real_rlock() if lock is None else lock)
 
     threading.Lock = make_lock
     threading.RLock = make_rlock

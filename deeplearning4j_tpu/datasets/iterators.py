@@ -162,6 +162,10 @@ class AsyncDataSetIterator(DataSetIterator):
         self._queue: "queue.Queue" = queue.Queue(self._size)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        # stop signal as an Event, not a bare bool: Event.set()/is_set()
+        # is a sanctioned cross-thread happens-before channel (graftlint
+        # CC005 flagged the original lock-free flag)
+        self._stop = threading.Event()
         self._gen = 0  # worker generation token (see reset)
         self._start()
 
@@ -175,13 +179,13 @@ class AsyncDataSetIterator(DataSetIterator):
         q = queue.Queue(self._size)
         self._queue = q
         self._error = None
-        self._stop = False
+        self._stop.clear()
 
         def worker():
             try:
-                while not self._stop and gen == self._gen:
+                while not self._stop.is_set() and gen == self._gen:
                     ds = self._under.next_batch()
-                    if self._stop or gen != self._gen:
+                    if self._stop.is_set() or gen != self._gen:
                         return  # superseded DURING the blocking call:
                         # drop the batch, never touch _under or q again
                     q.put(self._SENTINEL if ds is None else ds)
@@ -207,8 +211,12 @@ class AsyncDataSetIterator(DataSetIterator):
             # worker can still be inside `_under.next_batch()` — a timed
             # join that gives up would leave two workers consuming the
             # same underlying iterator (duplicated/dropped batches)
-            self._stop = True
-            self._gen += 1
+            self._stop.set()
+            # generation bump: a GIL-atomic int store the superseded
+            # worker reads lock-free; a stale read is benign (it drops
+            # the batch at its next check) — the join loop below is the
+            # hard barrier before _under is handed to a successor
+            self._gen += 1  # graftlint: disable=CC005
             while t.is_alive():
                 try:
                     self._queue.get(timeout=0.01)

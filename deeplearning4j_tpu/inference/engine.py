@@ -219,10 +219,13 @@ class DecodeHandle:
         self.retries += 1
         self.tokens = []
         self._error = None
-        self.t_admitted = None
-        self.t_restored = None
-        self.t_first_token = None
-        self.t_done = None
+        # one statement, GIL-atomic per store: only the supervisor calls
+        # this, only for handles of a FENCED engine (its thread joined or
+        # exiting at the fence check), so no writer races it; a client
+        # thread calling timings() mid-reset reads each phase stamp
+        # either old or None — both of which timings() already clamps
+        self.t_admitted = self.t_restored = None  # graftlint: disable=CC005
+        self.t_first_token = self.t_done = None  # graftlint: disable=CC005
         self.steps_to_first_token = None
 
     def done(self) -> bool:
@@ -959,7 +962,13 @@ class DecodeScheduler:
         return out
 
     def _reset_slot_state(self, slot: int) -> None:
-        self._states = self._jzero(self._states, device_index(slot))
+        # _states is single-writer by protocol: only the scheduler thread
+        # mutates it once start() returns. warmup() — the one cross-thread
+        # reader — runs exclusively inside supervisor-owned windows
+        # (construction / recovery / drain-swap) while this engine's loop
+        # is idle-by-construction (no slot admitted yet), and stop()'s
+        # sweep runs after the join. CC005 cannot see that protocol.
+        self._states = self._jzero(self._states, device_index(slot))  # graftlint: disable=CC005
 
     # -- prefix KV reuse (kvpool.py) ---------------------------------------
     def _try_restore(self, slot: int, seq: _ActiveSeq) -> None:
@@ -1009,7 +1018,9 @@ class DecodeScheduler:
         n_full = len(seq.prompt) // B
         if n_full < 1:
             return
-        start, new_ids = self.pool.insert(seq.prompt[:n_full * B])
+        # the pool is scheduler-thread-only past start() (same protocol
+        # as _states above; stop() touches it only after the join)
+        start, new_ids = self.pool.insert(seq.prompt[:n_full * B])  # graftlint: disable=CC005
         off = 0
         while off < len(new_ids):
             b = max(k for k in self.restore_buckets
@@ -1067,7 +1078,9 @@ class DecodeScheduler:
             j = len(seq.block_ids)
             seq.block_ids.append(bid)
             seq.shared.append(False)
-            self._table[slot, j] = bid
+            # host block table: scheduler-thread-only past start(), like
+            # _states/pool above (stop() frees rows only after the join)
+            self._table[slot, j] = bid  # graftlint: disable=CC005
             added += 1
         if added and self.tracer.enabled:
             self.tracer.instant(
@@ -1161,8 +1174,11 @@ class DecodeScheduler:
         seq.phase = "preempted"
         seq.resumed = True
         # single-writer: _slots is mutated only on this scheduler thread
-        # (same discipline as _step_once); _cond guards only the queue
-        self._slots[slot] = None  # graftlint: disable=CC004
+        # (same discipline as _step_once); _cond guards only the queue.
+        # Cross-thread readers (inflight(), stop()'s post-join sweep)
+        # read the list reference GIL-atomically and tolerate a one-
+        # entry-stale view — CC005 cannot see the single-writer protocol
+        self._slots[slot] = None  # graftlint: disable=CC004,CC005
         with self._cond:
             self._queue.insert(0, seq)
             self._m_queue_depth.set(len(self._queue))
@@ -1836,8 +1852,14 @@ class DecodeScheduler:
         the new engine now owns. The residual window — a thread awake
         and past the fence checks at the exact fencing instant — is one
         iteration wide; the supervisor additionally joins the thread
-        with a grace timeout before resubmitting."""
-        self._fenced = True
+        with a grace timeout before resubmitting.
+
+        The fence flag is DELIBERATELY a lock-free GIL-atomic bool: the
+        hung loop thread it must reach may be stuck inside an XLA
+        dispatch and can never be required to take a lock to learn it
+        was disowned; the one-iteration staleness window is the
+        documented contract."""
+        self._fenced = True  # graftlint: disable=CC005
         with self._cond:
             self._running = False
             self._cond.notify_all()

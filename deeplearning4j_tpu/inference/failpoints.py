@@ -176,9 +176,11 @@ def parse_spec(spec: str):
 
 # -- module state ------------------------------------------------------------
 # `_armed` emptiness IS the fast path: fire() in a disarmed process is
-# one dict bool test. Mutated only under _arm_lock; read lock-free (dict
-# reads are atomic; a fire racing a disarm either sees the arm or not,
-# both fine).
+# one lock-free dict bool test (GIL-atomic; a fire racing an arm either
+# sees it or misses one hit — both fine, and the suppressed CC005 at the
+# fast path documents it). Every OTHER access — arming, disarming, the
+# armed path's lookup, and the bound metrics registry — goes through
+# _arm_lock.
 _armed: Dict[str, _Arm] = {}
 _arm_lock = threading.Lock()
 _metrics = None  # bound MetricsRegistry (failpoint_triggers_total)
@@ -190,9 +192,13 @@ _HANG_SLICE_S = 0.05
 
 def bind_metrics(registry) -> None:
     """Point ``failpoint_triggers_total`` at a server's MetricsRegistry
-    (the registry is process-global; servers each own their metrics)."""
+    (the registry is process-global; servers each own their metrics).
+    Written under the arm lock so ``fire()``'s armed path (which reads
+    it under the same lock) can never observe a half-published registry
+    — graftlint CC005 caught the original lock-free publish."""
     global _metrics
-    _metrics = registry
+    with _arm_lock:
+        _metrics = registry
 
 
 def arm(name: str, spec: str) -> None:
@@ -247,13 +253,23 @@ def fire(name: str) -> None:
     """The seam call. Disarmed: one dict emptiness test, nothing else.
     Armed and triggered: raises the configured typed fault (after the
     configured sleep, for hangs)."""
-    if not _armed:
+    # lock-free FAST PATH by design: the disarmed production hot loop
+    # must not take a lock per seam. A dict emptiness read is one
+    # GIL-atomic bytecode; racing an arm() either sees the arm (fires)
+    # or misses this one hit (the next hit fires) — both correct.
+    if not _armed:  # graftlint: disable=CC005
         return
-    arm_ = _armed.get(name)
+    # armed (slow) path: the arm and the bound metrics registry are
+    # fetched under the same lock arm()/disarm()/bind_metrics() publish
+    # them under, so a fire racing a re-arm can never observe a
+    # half-constructed _Arm or half-published registry
+    with _arm_lock:
+        arm_ = _armed.get(name)
+        metrics = _metrics
     if arm_ is None or not arm_.should_fire():
         return
-    if _metrics is not None:
-        _metrics.counter("failpoint_triggers_total").inc()
+    if metrics is not None:
+        metrics.counter("failpoint_triggers_total").inc()
     if arm_.action == "crash":
         raise InjectedCrash(name, arm_.spec)
     if arm_.action == "oom":
@@ -261,7 +277,9 @@ def fire(name: str) -> None:
     # hang: sleep in slices (a disarm cuts the stall short), then raise
     deadline = time.monotonic() + arm_.ms / 1e3
     while time.monotonic() < deadline:
-        if _armed.get(name) is not arm_:
+        with _arm_lock:
+            current = _armed.get(name)
+        if current is not arm_:
             break  # disarmed / re-armed mid-hang: release the thread
         time.sleep(min(_HANG_SLICE_S,
                        max(0.0, deadline - time.monotonic())))

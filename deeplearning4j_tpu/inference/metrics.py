@@ -47,7 +47,10 @@ class Counter:
 
     @property
     def value(self) -> int:
-        return self._value
+        # single int, GIL-atomic read: a scrape racing inc() sees the
+        # count from one instant earlier — a correct counter value. The
+        # lock exists for the read-modify-write in inc(), not for this.
+        return self._value  # graftlint: disable=CC005
 
 
 class Gauge:
@@ -68,11 +71,15 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        # GIL-atomic single-float read (see Counter.value): any value
+        # this returns was the gauge's value at some instant
+        return self._value  # graftlint: disable=CC005
 
     @property
     def max(self) -> float:
-        return self._max
+        # GIL-atomic; _max is monotonic within a process lifetime, so a
+        # stale read only ever under-reports by the in-flight sample
+        return self._max  # graftlint: disable=CC005
 
 
 def _log_buckets(lo: float, hi: float, per_decade: int) -> List[float]:
@@ -122,7 +129,9 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        # GIL-atomic single-int read; consistent multi-field snapshots
+        # go through _state() under the lock (the CC004 fix)
+        return self._count  # graftlint: disable=CC005
 
     @property
     def mean(self) -> float:

@@ -214,7 +214,11 @@ class EngineSupervisor:
         self._g_ready = m.gauge("serving_ready")
         self._warm_on_build = bool(warm_on_build)
         self._kick = threading.Event()  # crash callback -> prompt poll
-        self.engine = self._spawn_engine()
+        # under the lock like every other _spawn_engine call site: the
+        # watchdog starts below and the degradation/engine state it reads
+        # is lock-guarded from the first instant
+        with self._lock:
+            self.engine = self._spawn_engine()
         self._g_ready.set(1)
         self._watchdog: Optional[threading.Thread] = None
         if watchdog:
@@ -233,7 +237,7 @@ class EngineSupervisor:
         fresh hang and churn restarts until the retry budgets died)."""
         eng = self._factory()
         eng._on_crash = self._note_crash
-        self._apply_degradation(eng)
+        self._apply_degradation(eng, self.degradation_level)
         eng.start()
         if self._warm_on_build:
             self._warm(eng)
@@ -281,20 +285,27 @@ class EngineSupervisor:
     def check(self) -> None:
         """One watchdog evaluation: crash/hang detection + the
         degradation ladder. Normally driven by the background thread;
-        tests call it directly with an injected frozen clock."""
-        if self._stopping or self._draining:
-            return
-        eng = self.engine
-        if eng.crashed is not None:
-            self._recover("crash", eng)
-            return
-        limit = (self.hang_timeout_s if eng.iterations > 0
-                 else self.warmup_timeout_s)
-        if self._clock() - eng.heartbeat > limit:
-            self._recover("hang", eng)
-            return
-        self._evaluate_ladder(eng)
-        self._prune_done()
+        tests call it directly with an injected frozen clock.
+
+        The whole evaluation holds ``self._lock`` (reentrant — recovery
+        re-acquires it): the ladder counters and the engine identity are
+        otherwise written by this watchdog thread while ``submit()``
+        reads them under the lock, the lockset-empty cross-thread access
+        graftlint CC005 flagged."""
+        with self._lock:
+            if self._stopping or self._draining:
+                return
+            eng = self.engine
+            if eng.crashed is not None:
+                self._recover("crash", eng)
+                return
+            limit = (self.hang_timeout_s if eng.iterations > 0
+                     else self.warmup_timeout_s)
+            if self._clock() - eng.heartbeat > limit:
+                self._recover("hang", eng)
+                return
+            self._evaluate_ladder(eng)
+            self._prune_done()
 
     # -- crash recovery ----------------------------------------------------
     def _recover(self, reason: str, dead: DecodeScheduler) -> None:
@@ -447,16 +458,19 @@ class EngineSupervisor:
     def _set_level(self, level: int) -> None:
         self.degradation_level = level
         self._g_level.set(level)
-        self._apply_degradation(self.engine)
+        self._apply_degradation(self.engine, level)
         self.tracer.instant("degrade", track="supervisor",
                             args={"level": level})
 
-    def _apply_degradation(self, eng: DecodeScheduler) -> None:
-        """Project the current rung onto an engine (also called on every
-        rebuild, so a restart under pressure comes up degraded, not
-        amnesiac)."""
+    @staticmethod
+    def _apply_degradation(eng: DecodeScheduler, level: int) -> None:
+        """Project a degradation rung onto an engine (also called on
+        every rebuild, so a restart under pressure comes up degraded,
+        not amnesiac). Takes the rung as a parameter — callers read
+        ``degradation_level`` under whatever lock they already hold —
+        instead of re-reading shared state lock-free here."""
         eng.chunk_cap = (max(1, eng.prefill_chunk // 2)
-                         if self.degradation_level >= 2 else None)
+                         if level >= 2 else None)
 
     # -- admission / client side -------------------------------------------
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int,
@@ -541,10 +555,18 @@ class EngineSupervisor:
     @property
     def ready(self) -> bool:
         """`/readyz`: able to take traffic NOW — not stopping, not
-        draining, not mid-recovery, engine loop alive and beating."""
-        if self._stopping or self._draining or self._recovering:
+        draining, not mid-recovery, engine loop alive and beating.
+
+        Deliberately LOCK-FREE: ``self._lock`` is held for the whole of
+        a recovery (backoff sleep + rebuild + warm-up compiles, seconds)
+        and a readiness probe must answer "not ready" DURING that
+        window, not block until it ends. Every read here is one
+        GIL-atomic bool/ref load; a probe racing a flag flip returns the
+        verdict from one instant earlier — exactly as correct for a
+        poller."""
+        if self._stopping or self._draining or self._recovering:  # graftlint: disable=CC005
             return False
-        eng = self.engine
+        eng = self.engine  # graftlint: disable=CC005 — atomic ref read, see above
         if eng.crashed is not None:
             return False
         limit = (self.hang_timeout_s if eng.iterations > 0
@@ -552,16 +574,20 @@ class EngineSupervisor:
         return (self._clock() - eng.heartbeat) <= limit
 
     def status(self) -> dict:
-        """The `/readyz` body (and the UI's robustness line)."""
+        """The `/readyz` body (and the UI's robustness line). Lock-free
+        for the same reason as :attr:`ready` — each field is one
+        GIL-atomic scalar/ref read, and a diagnostics snapshot one flag
+        flip stale is fine; blocking /readyz on the seconds-long
+        recovery lock hold is not."""
         eng = self.engine
         return {
             "ready": self.ready,
             "draining": self._draining,
             "recovering": self._recovering,
-            "degradation_level": self.degradation_level,
-            "restarts": self.restarts,
+            "degradation_level": self.degradation_level,  # graftlint: disable=CC005
+            "restarts": self.restarts,  # graftlint: disable=CC005 — atomic int read, see docstring
             "heartbeat_age_s": round(self._clock() - eng.heartbeat, 3),
-            "inflight": len(self._tracked),
+            "inflight": len(self._tracked),  # graftlint: disable=CC005 — atomic len(), see docstring
         }
 
     def drain(self, timeout: Optional[float] = None,

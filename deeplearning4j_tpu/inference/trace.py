@@ -200,8 +200,10 @@ class FlightRecorder:
 
     def clear(self) -> None:
         """Reset the ring (tests / between bench rounds). Not safe
-        against concurrent writers — quiesce first."""
-        self._buf = [None] * self.capacity
+        against concurrent writers — quiesce first: that contract (not a
+        lock) is what orders this swap against `_append`'s lock-free
+        slot claims, hence the reviewed CC005 suppression."""
+        self._buf = [None] * self.capacity  # graftlint: disable=CC005
         self._seq = itertools.count()
         self._t0 = time.monotonic()
 

@@ -223,7 +223,15 @@ class SequenceVectors:
         2x LeNet win). Pair order: global shuffle becomes per-group shuffle,
         matching the reference's streaming order (SkipGram.java never
         shuffles across sentences; epoch_seqs is already permuted).
-        Returns (seen, last_loss)."""
+        Returns (seen, last_loss).
+
+        Cross-thread discipline (vetted by graftlint's CC005 lockset
+        race pass): every producer<->consumer hand-off rides a
+        sanctioned happens-before channel — chunks through the bounded
+        Queue, shutdown through the `stop` Event, `producer_error` read
+        only after the join — and the producer touches no `self` state
+        the consumer writes (the scan state / `_chunk_counter` are
+        consumer-only)."""
         import queue as _queue
         import threading
         import time

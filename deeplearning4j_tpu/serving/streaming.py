@@ -209,7 +209,11 @@ class ServeRoute:
                     out = host_read(self.net.output(ds.features))
                     self.on_prediction(out)
             except BaseException as e:
-                self.error = e
+                # GIL-atomic ref store read lock-free by send()'s ADVISORY
+                # fail-fast check (a racing send that misses it enqueues
+                # one record nobody consumes — bounded, benign); stop()'s
+                # definitive read happens after the join
+                self.error = e  # graftlint: disable=CC005
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()

@@ -559,6 +559,225 @@ def test_cc004_torn_lock_guarded_read(tmp_path):
     assert found[0].symbol == "Hist.snapshot" and "_vmin" in found[0].message
 
 
+# --------------------------------------------------- race rules (CC005+) --
+_RACE_FIXTURE = """
+import itertools
+import queue
+import threading
+
+_LOCK = threading.Lock()
+_REGISTRY = {}
+
+
+def record_global(x):
+    _REGISTRY["k"] = x          # worker-side mutate, no lock: TP (global)
+
+
+def read_global():
+    with _LOCK:
+        return dict(_REGISTRY)  # client-side read under the lock
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._evt = threading.Event()
+        self._seq = itertools.count()
+        self._ring = [None] * 8
+        self._shared_plain = 0
+        self._shared_locked = 0
+        self._published = None
+        self._flagged = None
+        self._preonly = 0
+        self._thread = None
+
+    def start(self):
+        self._preonly = 1                 # TN: before Thread.start
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._shared_locked += 1  # TN: same lock both sides
+            self._published = object()
+            self._q.put("tick")           # queue-publishes _published
+            self._flagged = 1
+            self._evt.set()               # event-publishes _flagged
+            i = next(self._seq)
+            self._ring[i % 8] = i         # TN: count slot claim
+            record_global(i)
+            self._shared_plain += 1       # TP: no lock, no channel
+
+    def poll(self):
+        if self._shared_plain > 3:        # TP counterpart (lock-free)
+            return None
+        with self._lock:
+            x = self._shared_locked       # TN
+        self._q.get()
+        got = self._published             # TN: queue-received
+        self._evt.wait()
+        f = self._flagged                 # TN: event-received
+        return (x, got, f)
+
+    def snapshot_ring(self):
+        return list(self._ring)           # TN: writer holds a slot claim
+
+    def stop(self):
+        self._thread.join()
+        return self._preonly              # TN: after Thread.join
+
+
+class Publisher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._work)
+        self._thread.start()
+
+    def _work(self):
+        while True:
+            self._items.append(1)         # CC006: mutated lock-free...
+
+    def swap(self):
+        with self._lock:
+            self._items = []              # ...but published under lock
+
+
+class NotThreaded:
+    # has a lock but no thread and no worker-reachable method: OUT OF
+    # SCOPE — the sloppy lock-free read below must not fire
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        return self._n
+"""
+
+
+def test_cc005_lockset_race_detection_tp_tn(tmp_path):
+    from deeplearning4j_tpu.analysis.races import SharedStateNoLock
+    found = _lint(tmp_path, _RACE_FIXTURE, [SharedStateNoLock()])
+    msgs = {f.message.split(" is ")[0]: f for f in found}
+    # exactly the two true positives: the unsynchronized attr and the
+    # lock-free global mutate — every sanctioned channel stays clean
+    assert set(msgs) == {"self._shared_plain",
+                        "module global '_REGISTRY'"}, \
+        [f.format() for f in found]
+    assert all(f.rule == "CC005" for f in found)
+    assert "empty lockset intersection" in msgs["self._shared_plain"].message
+
+
+def test_cc006_published_ref_mutated_lock_free(tmp_path):
+    from deeplearning4j_tpu.analysis.races import (
+        PublishedRefMutatedLockFree, SharedStateNoLock)
+    found = _lint(tmp_path, _RACE_FIXTURE, [PublishedRefMutatedLockFree()])
+    assert len(found) == 1 and found[0].rule == "CC006"
+    assert "_items" in found[0].message
+    assert found[0].symbol == "Publisher._work"
+    # the same attr is NOT double-reported by CC005
+    cc005 = _lint(tmp_path, _RACE_FIXTURE, [SharedStateNoLock()],
+                  name="again.py")
+    assert not any("_items" in f.message for f in cc005)
+
+
+def test_cc005_from_import_cross_module_hop(tmp_path):
+    """Worker reachability crosses `from X import f` imports: the
+    thread-target loop calls a helper imported from another module, and
+    that helper's lock-free mutate of the other module's lock-guarded
+    global must be reported THERE."""
+    from deeplearning4j_tpu.analysis.races import SharedStateNoLock
+    (tmp_path / "helper.py").write_text(textwrap.dedent("""
+    import threading
+
+    _LOCK = threading.Lock()
+    _STATE = {}
+
+
+    def record_thing(x):
+        _STATE["k"] = x          # worker-side (via main.py), no lock
+
+
+    def read_things():
+        with _LOCK:
+            return dict(_STATE)
+    """))
+    (tmp_path / "main.py").write_text(textwrap.dedent("""
+    import threading
+
+    from helper import record_thing
+
+
+    class W:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                record_thing(1)
+    """))
+    findings, errors = Linter([SharedStateNoLock()]).run([tmp_path])
+    assert not errors, errors
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert findings[0].path.endswith("helper.py")
+    assert "_STATE" in findings[0].message
+
+
+def test_cc005_string_join_does_not_sanction_post_join(tmp_path):
+    """`", ".join(parts)` is not a Thread.join: accesses after it must
+    NOT inherit the post-join sanction (only joins on known threads, or
+    join-shaped calls — no args / timeout — qualify)."""
+    from deeplearning4j_tpu.analysis.races import SharedStateNoLock
+    src = """
+    import threading
+
+
+    class W:
+        def __init__(self):
+            self._n = 0
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                self._n += 1
+
+        def report(self, parts):
+            label = ", ".join(parts)
+            return label, self._n      # still racy: str.join orders nothing
+
+        def stop(self):
+            self._thread.join()
+            return self._n             # genuinely post-join: sanctioned
+    """
+    found = _lint(tmp_path, src, [SharedStateNoLock()])
+    assert len(found) == 1 and "_n" in found[0].message, \
+        [f.format() for f in found]
+
+
+def test_cc005_inline_suppression(tmp_path):
+    from deeplearning4j_tpu.analysis.races import SharedStateNoLock
+    src = _RACE_FIXTURE.replace(
+        "self._shared_plain += 1       # TP: no lock, no channel",
+        "self._shared_plain += 1  # graftlint: disable=CC005")
+    found = _lint(tmp_path, src, [SharedStateNoLock()])
+    assert not any("_shared_plain" in f.message for f in found), \
+        [f.format() for f in found]
+
+
 # ------------------------------------------- suppressions and baselining --
 def test_inline_suppression_by_rule_and_blanket(tmp_path):
     src = """

@@ -1,0 +1,345 @@
+"""Runtime race-checker tests (ISSUE 8 satellite).
+
+Vector-clock algebra unit tests (fork/join, queue hand-off, event
+broadcast, reentrant locks), FastTrack-lite detector true positives /
+true negatives under each sanctioned happens-before channel, shim
+restoration guarantees, and the disarmed fast path. The STATIC rule
+fixtures (CC005/CC006) live in tests/test_graftlint.py with the other
+rule packs; the live-serving and chaos integration runs live in
+tests/test_lint_clean.py and tests/test_chaos.py.
+"""
+import queue
+import threading
+
+import pytest
+
+from deeplearning4j_tpu.analysis.races import (RaceDetector, VectorClock,
+                                               race_audit)
+
+
+# ------------------------------------------------------ vector clocks --
+def test_vector_clock_join_tick_dominates():
+    a, b = VectorClock(), VectorClock()
+    a.tick(1)
+    a.tick(1)
+    b.tick(2)
+    assert a.get(1) == 2 and a.get(2) == 0
+    b.join(a)
+    assert b.c == {1: 2, 2: 1}
+    a.join(b)  # join is pointwise max, commutative on the result set
+    assert a.c == {1: 2, 2: 1}
+    assert b.dominates(1, 2) and not b.dominates(1, 3)
+    assert b.dominates(99, 0)  # unknown thread at event 0: vacuous
+
+
+def test_vector_clock_copy_is_independent():
+    a = VectorClock({1: 1})
+    c = a.copy()
+    c.tick(1)
+    assert a.get(1) == 1 and c.get(1) == 2
+
+
+# ---------------------------------------------- detector TP/TN basics --
+def test_detector_flags_unsynchronized_cross_thread_access():
+    with race_audit() as det:
+        class Box:
+            pass
+        b = Box()
+        b.n = 0
+        det.watch(b, ["n"], label="box")
+
+        def bump():
+            for _ in range(50):
+                b.n += 1
+
+        ts = [threading.Thread(target=bump) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert det.violations
+    kinds = {(v["kind"], v["racing_kind"]) for v in det.violations}
+    assert ("write", "write") in kinds or ("read", "write") in kinds
+    assert any("box.n" == v["var"] for v in det.violations)
+    # one report per (var, access-pair kind): no flood
+    assert len(det.violations) <= 4
+
+
+def test_detector_lock_discipline_is_clean_and_reentrant():
+    """Lock-guarded increments are ordered; RLock re-entry below another
+    lock must neither deadlock the clock bookkeeping nor fabricate a
+    violation."""
+    with race_audit() as det:
+        class Box:
+            pass
+        b = Box()
+        b.n = 0
+        r = threading.RLock()
+        det.watch(b, ["n"], label="box")
+
+        def bump():
+            for _ in range(50):
+                with r:
+                    with r:  # reentrant acquire
+                        b.n += 1
+
+        ts = [threading.Thread(target=bump) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        with r:
+            assert b.n == 100
+    assert det.violations == [], det.format_violations()
+
+
+def test_detector_fork_join_edges():
+    """Parent-before-child (start) and child-before-parent (join) are
+    both sanctioned: parent writes, child reads, child writes, parent
+    reads after join — all ordered, zero violations."""
+    with race_audit() as det:
+        class Box:
+            pass
+        b = Box()
+        b.x = 0
+        det.watch(b, ["x"], label="box")
+        b.x = 1  # parent write BEFORE start: child inherits the clock
+        seen = []
+
+        def child():
+            seen.append(b.x)  # ordered by Thread.start
+            b.x = 2           # ordered before the parent's post-join read
+
+        t = threading.Thread(target=child)
+        t.start()
+        t.join()
+        assert b.x == 2 and seen == [1]
+    assert det.violations == [], det.format_violations()
+
+
+def test_detector_queue_handoff_edge():
+    with race_audit() as det:
+        class Box:
+            pass
+        b = Box()
+        b.payload = None
+        det.watch(b, ["payload"], label="box")
+        q = queue.Queue()
+
+        def producer():
+            b.payload = 42  # published by the put below
+            q.put("ready")
+
+        t = threading.Thread(target=producer)
+        t.start()
+        q.get()               # receive: joins the producer's clock
+        assert b.payload == 42
+        t.join()
+    assert det.violations == [], det.format_violations()
+
+
+def test_detector_flag_spin_without_channel_is_flagged():
+    """Publishing through a plain Python flag instead of an Event/Queue
+    gives the consumer no happens-before edge — the bug class CC005's
+    sanctioned-channel table exists to push code away from."""
+    with race_audit() as det:
+        class Box2:
+            pass
+        b2 = Box2()
+        b2.payload = None
+        det.watch(b2, ["payload"], label="box2")
+        done = [False]
+
+        def producer2():
+            b2.payload = 42
+            done[0] = True  # plain list store: no clock attached
+
+        t2 = threading.Thread(target=producer2)
+        t2.start()
+        while not done[0]:
+            pass
+        _ = b2.payload  # racy read: no HB edge from the plain flag
+        t2.join()
+    assert det.violations, "missing-happens-before read went undetected"
+    assert det.violations[0]["var"] == "box2.payload"
+
+
+def test_detector_event_broadcast_edge():
+    with race_audit() as det:
+        class Box:
+            pass
+        b = Box()
+        b.flag = 0
+        det.watch(b, ["flag"], label="box")
+        ev = threading.Event()
+
+        def setter():
+            b.flag = 7
+            ev.set()
+
+        t = threading.Thread(target=setter)
+        t.start()
+        assert ev.wait(10)
+        assert b.flag == 7  # ordered by set -> wait
+        t.join()
+    assert det.violations == [], det.format_violations()
+
+
+def test_detector_condition_wait_notify_edge():
+    """Condition-variable hand-off (the engine/batcher idiom): writes
+    under the condvar before notify happen-before reads under it after
+    wait returns."""
+    with race_audit() as det:
+        class Box:
+            pass
+        b = Box()
+        b.items = 0
+        cond = threading.Condition()
+        det.watch(b, ["items"], label="box")
+
+        def producer():
+            with cond:
+                b.items = 5
+                cond.notify()
+
+        t = threading.Thread(target=producer)
+        t.start()
+        with cond:
+            while b.items == 0:
+                cond.wait(5)
+        t.join()
+    assert det.violations == [], det.format_violations()
+
+
+# ----------------------------------------------- lifecycle / plumbing --
+def test_shims_are_fully_reverted_on_exit():
+    q0, e0, t0 = queue.Queue, threading.Event, threading.Thread
+    l0, c0 = threading.Lock, threading.Condition
+    with race_audit():
+        assert queue.Queue is not q0
+        assert threading.Event is not e0
+        assert threading.Thread is not t0
+        assert threading.Lock is not l0
+        assert threading.Condition is not c0
+    assert queue.Queue is q0 and threading.Event is e0
+    assert threading.Thread is t0 and threading.Lock is l0
+    assert threading.Condition is c0
+
+
+def test_watch_patch_restored_and_tracer_disabled_after_exit():
+    class Box:
+        pass
+    orig_get = Box.__getattribute__
+    orig_set = Box.__setattr__
+    with race_audit() as det:
+        b = Box()
+        b.n = 0
+        det.watch(b, ["n"])
+        assert Box.__getattribute__ is not orig_get
+        b.n = 1
+    assert Box.__getattribute__ is orig_get
+    assert Box.__setattr__ is orig_set
+    b.n = 2  # no tracing, no violation bookkeeping after close
+    assert not det.enabled
+
+
+def test_disarmed_until_first_watch():
+    """Before any watch() the shims must do no clock work at all — the
+    state the bench.py `race_audit` floor holds at <= 2% decode-loop
+    cost."""
+    with race_audit() as det:
+        assert det.tracking is False
+        lk = threading.Lock()
+        with lk:
+            pass
+        assert det._sync_clocks == {}  # no clocks maintained
+        ev = threading.Event()
+        ev.set()
+        assert det._sync_clocks == {}
+
+        class Box:
+            pass
+        b = Box()
+        det.watch(b, ["n"])
+        assert det.tracking is True
+        with lk:  # from arming on, the same primitives carry clocks
+            pass
+        assert det._sync_clocks != {}
+
+
+def test_default_watch_covers_all_non_dunder_attrs():
+    with race_audit() as det:
+        class Box:
+            pass
+        b = Box()
+        b.a = 1
+        det.watch(b)  # no attr list: everything non-dunder
+
+        def writer():
+            b.a = 2
+
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join()      # joined: ordered, clean
+        assert b.a == 2
+    assert det.violations == [], det.format_violations()
+
+
+def test_detector_standalone_epoch_logic():
+    """RaceDetector without the audit context: epochs + explicit clock
+    edges drive the same verdicts (the unit seam the shims sit on)."""
+    det = RaceDetector()
+
+    class Box:
+        pass
+    b = Box()
+    det.watch(b, ["v"])
+    b.v = 0  # traced: detector armed by watch, patch installed
+    try:
+        snap = det.snapshot()  # main's clock at "send"
+        results = []
+
+        def child_ordered():
+            det.seed_current(snap)
+            results.append(b.v)
+
+        t = threading.Thread(target=child_ordered)
+        t.start()
+        t.join()
+        det.join_current(getattr(t, "_graft_final", None))
+        assert det.violations == [], det.format_violations()
+
+        def child_racy():
+            results.append(b.v)  # never seeded: no HB edge
+
+        t2 = threading.Thread(target=child_racy)
+        t2.start()
+        t2.join()
+        assert det.violations, "unseeded cross-thread read undetected"
+    finally:
+        det.close()
+
+
+def test_watch_subclass_after_base_does_not_leak_hooks():
+    """Watching a derived-class instance after its base class was
+    patched must not re-wrap the base's traced hooks (close() would
+    then 'restore' the wrapper and leave tracing installed forever)."""
+    class Base:
+        pass
+
+    class Derived(Base):
+        pass
+
+    with race_audit() as det:
+        b, d = Base(), Derived()
+        b.x = 0
+        d.x = 0
+        det.watch(b, ["x"])
+        det.watch(d, ["x"])  # Base already patched: must be a no-op
+        assert Derived not in det._patched
+        d.x = 1  # still traced through Base's hook
+    # both classes fully reverted: no traced hooks survive the context
+    assert "__getattribute__" not in Base.__dict__
+    assert "__setattr__" not in Base.__dict__
+    assert "__getattribute__" not in Derived.__dict__
+    d.x = 2  # plain attribute machinery again
