@@ -113,6 +113,7 @@ def cmd_serve(args) -> int:
               prefix_cache_mb=args.prefix_cache_mb,
               kv_block=args.kv_block,
               kv_pool_mb=args.kv_pool_mb,
+              decode_tp=args.tp,
               trace_buffer=args.trace_buffer,
               supervise=not args.no_supervise,
               hang_timeout_s=args.hang_timeout,
@@ -171,6 +172,17 @@ def cmd_serve(args) -> int:
     decoder = getattr(server, "_decoder", None)
     pool_on = getattr(decoder, "pool", None) is not None
     paged_on = bool(getattr(decoder, "paged", False))
+    # mesh topology: the ENGINE's actual tp (the scheduler disables
+    # sharding with a RuntimeWarning when heads don't divide), not the
+    # flag
+    tp_on = int(getattr(decoder, "tp", 1))
+    if tp_on > 1:
+        import jax
+        mesh_mode = (f", tensor-parallel over {tp_on} of "
+                     f"{len(jax.devices())} devices (tp axis; KV pool "
+                     "head-sharded, per-device budgets)")
+    else:
+        mesh_mode = ""
     if paged_on:
         kv_mode = (f", paged KV pool {args.kv_pool_mb}MB "
                    f"({decoder.pool.capacity_blocks} blocks of "
@@ -181,7 +193,7 @@ def cmd_serve(args) -> int:
     else:
         kv_mode = ", prefix cache OFF"
     gen_mode = (f"; /generate: {args.decode_slots} slots, "
-                f"prefill chunk {args.prefill_chunk}" + kv_mode
+                f"prefill chunk {args.prefill_chunk}" + kv_mode + mesh_mode
                 + (f", supervised (hang timeout {args.hang_timeout}s, "
                    f"retry budget {args.retry_budget})"
                    if not args.no_supervise else ", UNSUPERVISED")
@@ -289,6 +301,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "remap, and cold slots preempt-and-resume under "
                         "pressure; supersedes --prefix-cache-mb "
                         "(0 = contiguous per-slot caches)")
+    s.add_argument("--tp", type=int, default=0,
+                   help="shard the decode engine tensor-parallel over N "
+                        "devices (attention heads/FFN split over a 'tp' "
+                        "mesh axis, KV pool sharded by head — pool "
+                        "budgets become per-device bytes; 0/1 = single "
+                        "device; CPU test meshes via XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N)")
     s.add_argument("--kv-block", type=int, default=16,
                    help="positions per KV block, paged pool and prefix "
                         "cache alike (only full blocks of a prompt are "

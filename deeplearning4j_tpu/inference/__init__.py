@@ -13,7 +13,12 @@ latency percentiles, exported at
 `GET /metrics`; the `FlightRecorder` span flight recorder (`trace.py`)
 records every request's lifecycle — queued/restore/prefill/decode span
 trees plus scheduler instants — exported at `GET /trace` (JSON or
-Perfetto-loadable Chrome trace-event format).
+Perfetto-loadable Chrome trace-event format). With ``mesh=N``
+(`sharding.py`) the whole decode stack runs tensor-parallel over a
+``tp`` device mesh: heads/FFN sharded, KV pool head-sharded (per-device
+byte budgets — ``tp×`` the blocks at fixed per-device HBM), block
+tables replicated, and the per-token program audited to carry only the
+Megatron all-reduces (no resharding collectives on the hot path).
 """
 from .batcher import (InferenceFuture, MicroBatcher, QueueFullError,
                       RequestTimeoutError, bucket_for, pow2_buckets)
@@ -24,6 +29,8 @@ from .failpoints import (InjectedCrash, InjectedFault, InjectedHang,
 from .kvpool import KVPool
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry)
+from .sharding import (TP_AXIS, collective_counts, decode_mesh,
+                       decode_program_hlo, prefill_program_hlo)
 from .supervisor import (AdmissionRejectedError, EngineSupervisor,
                          RetryBudgetExceededError, ShuttingDownError)
 from .trace import FlightRecorder, default_recorder, new_request_id
@@ -34,6 +41,7 @@ __all__ = ["AdmissionRejectedError", "Counter", "DecodeHandle",
            "InjectedCrash", "InjectedFault", "InjectedHang", "InjectedOOM",
            "KVPool", "LoadSheddedError", "MetricsRegistry", "MicroBatcher",
            "PromptTooLongError", "QueueFullError", "RequestTimeoutError",
-           "RetryBudgetExceededError", "ShuttingDownError", "bucket_for",
-           "default_recorder", "default_registry", "new_request_id",
-           "pow2_buckets"]
+           "RetryBudgetExceededError", "ShuttingDownError", "TP_AXIS",
+           "bucket_for", "collective_counts", "decode_mesh",
+           "decode_program_hlo", "default_recorder", "default_registry",
+           "new_request_id", "pow2_buckets", "prefill_program_hlo"]

@@ -105,6 +105,9 @@ from . import failpoints
 from .batcher import QueueFullError, bucket_for, pow2_buckets
 from .kvpool import SCRATCH_BLOCK, KVPool, gather_blocks, scatter_blocks
 from .metrics import MetricsRegistry, default_registry
+from .sharding import (TP_AXIS, decode_mesh, kv_heads_shardable,
+                       shard_decode_params, state_shardings,
+                       storage_shardings)
 from .trace import FlightRecorder, default_recorder, new_request_id
 
 # chunk buckets never go below this (a 3-token tail still pads to one
@@ -349,6 +352,25 @@ class DecodeScheduler:
     enough to stay on in production. `GET /trace` on the serving server
     and `DecodeHandle.timings()` read it back.
 
+    ``mesh``: tensor-parallel device mesh (ISSUE 9). An int ``N > 1``
+    builds a 1-D ``tp`` mesh over the first N local devices
+    (`inference/sharding.py`); a `jax.sharding.Mesh` with a ``tp`` axis
+    is used as-is. Attention heads and FFN hidden dims shard across the
+    axis (Megatron pairing, output head replicated), the KV cache —
+    contiguous stripes and paged ``k_pages``/``v_pages`` alike — shards
+    on its Hkv head axis (``kv_pool_mb``/``prefix_cache_mb`` budgets
+    become PER-DEVICE bytes: at fixed per-device HBM the pool holds
+    ``tp×`` the blocks), and everything host-authoritative (block
+    tables, ids, masks, ``pos``) replicates — so paged attention,
+    prefix restore, COW, and preemption run unchanged per shard. The
+    per-token program's only collectives are the two Megatron
+    all-reduces per block (audited: `sharding.collective_counts`).
+    Requires a transformer ComputationGraph whose every Hkv divides the
+    axis size; otherwise tensor parallelism is DISABLED with a warning
+    and the engine runs single-device. The engine never mutates
+    ``net`` — it holds sharded param COPIES, so a live-trained net's
+    updates stop reaching a sharded engine (rebuild to pick them up).
+
     ``transfer_guard``: device-residency audit mode. When set (e.g.
     "disallow"), every scheduler iteration runs under that thread-local
     ``jax.transfer_guard`` level: any *implicit* host<->device transfer in
@@ -361,7 +383,7 @@ class DecodeScheduler:
     def __init__(self, net, vocab_size: int, *, n_slots: int = 4,
                  max_queue: int = 64, prefill_chunk: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
-                 kv_pool_mb: float = 0.0,
+                 kv_pool_mb: float = 0.0, mesh=None,
                  metrics: Optional[MetricsRegistry] = None,
                  tracer: Optional[FlightRecorder] = None,
                  transfer_guard: Optional[str] = None):
@@ -440,6 +462,57 @@ class DecodeScheduler:
         self._chunk_dense = bool(stateful) and all(
             type(impl).__name__ == "SelfAttentionLayerImpl"
             for impl in stateful)
+        attn_keys = [key for key, st in abstract_states.items()
+                     if isinstance(st, dict) and "k" in st and "v" in st
+                     and "pos" in st]
+        # -- tensor-parallel mesh (inference/sharding.py, ISSUE 9) --
+        # resolved BEFORE the KV layout: pool byte budgets are per-device
+        # (each device holds Hkv/tp heads per block), and the pool must
+        # know the shard factor to size capacity_blocks
+        if isinstance(mesh, int):
+            mesh = decode_mesh(mesh) if mesh > 1 else None
+        self.mesh = None
+        self.tp = 1
+        self._repl = None  # replicated NamedSharding for host feeds
+        if mesh is not None and mesh.shape.get(TP_AXIS, 1) <= 1:
+            # a mesh without a real tp axis would be SILENTLY ignored
+            # below — name the contract instead
+            warnings.warn(
+                f"mesh {dict(mesh.shape)} has no '{TP_AXIS}' axis of "
+                "size > 1; tensor-parallel decode is DISABLED "
+                "(build the mesh with inference.sharding.decode_mesh, "
+                "or pass mesh=<device count>)",
+                RuntimeWarning, stacklevel=2)
+        if mesh is not None and mesh.shape.get(TP_AXIS, 1) > 1:
+            tp = int(mesh.shape[TP_AXIS])
+            if not (self._graph and self._chunk_dense
+                    and kv_heads_shardable(abstract_states, attn_keys,
+                                           tp)):
+                warnings.warn(
+                    f"mesh tp={tp} requested but tensor-parallel decode "
+                    "is DISABLED (single-device engine instead): "
+                    + ("the model is not a transformer ComputationGraph "
+                       "with an attention KV cache to shard"
+                       if not (self._graph and self._chunk_dense
+                               and attn_keys)
+                       else "an attention layer's n_kv_heads is not "
+                            f"divisible by the tp axis size {tp} (the "
+                            "head-sharded cache cannot split a head)"),
+                    RuntimeWarning, stacklevel=2)
+            else:
+                self.mesh = mesh
+                self.tp = tp
+                from jax.sharding import NamedSharding, PartitionSpec
+                self._repl = NamedSharding(mesh, PartitionSpec())
+        self._sharded_params = self._sharded_variables = None
+        if self.mesh is not None:
+            # sharded COPIES — net keeps its own placement (a 1-device
+            # reference engine over the same net stays single-device).
+            # Unsharded engines read net.params LIVE at each dispatch
+            # (the _params property), preserving the pre-mesh contract
+            # that a retrained net's rebound params are picked up
+            self._sharded_params, self._sharded_variables = \
+                shard_decode_params(net, self.mesh)
         # KV memory layout (kvpool.py) — attention nets only: both modes
         # manage position-addressed K/V rows, which recurrent h/c state
         # does not have.
@@ -459,14 +532,12 @@ class DecodeScheduler:
         self._jsetpos = None
         self._jcow = None
         self._table: Optional[np.ndarray] = None
-        attn_keys = [key for key, st in abstract_states.items()
-                     if isinstance(st, dict) and "k" in st and "v" in st
-                     and "pos" in st]
         if kv_pool_mb and kv_pool_mb > 0:
             if self._chunk_dense and attn_keys and self.kv_block >= 1:
                 attn = {key: abstract_states[key] for key in attn_keys}
                 pool = KVPool(attn, block=self.kv_block, paged=True,
                               budget_bytes=int(kv_pool_mb * (1 << 20)),
+                              shard_factor=self.tp,
                               metrics=self.metrics, tracer=self.tracer)
                 if pool.capacity_blocks > 0:
                     self.pool = pool
@@ -479,24 +550,32 @@ class DecodeScheduler:
                     # materialize straight into the paged layout: the
                     # contiguous stripes are never allocated. Zeros match
                     # init_state for every entry — paged requires
-                    # _chunk_dense, so all stateful layers are attention
+                    # _chunk_dense, so all stateful layers are attention.
+                    # Under a mesh the page arrays stay HOST numpy here:
+                    # the total pool is tp x one device's budget, so a
+                    # device-side transient would OOM the very layout
+                    # sharding exists to escape — the state_shardings
+                    # device_put below ships each device ONLY its head
+                    # slice (host zeros are calloc'd virtual pages, ~free)
+                    zeros = (np.zeros if self.mesh is not None
+                             else jnp.zeros)
                     self._states = {
                         key: jax.tree_util.tree_map(
-                            lambda s: jnp.zeros(s.shape, s.dtype), st)
+                            lambda s: zeros(s.shape, s.dtype), st)
                         for key, st in abstract_states.items()
                         if key not in attn_keys}
                     for key in attn_keys:
                         st = abstract_states[key]
                         tail = st["k"].shape[2:]
                         self._states[key] = {
-                            "k_pages": jnp.zeros(
+                            "k_pages": zeros(
                                 (pages, self.kv_block) + tail,
                                 st["k"].dtype),
-                            "v_pages": jnp.zeros(
+                            "v_pages": zeros(
                                 (pages, self.kv_block) + tail,
                                 st["v"].dtype),
-                            "pos": jnp.zeros(st["pos"].shape,
-                                             st["pos"].dtype),
+                            "pos": zeros(st["pos"].shape,
+                                         st["pos"].dtype),
                         }
                     self._cache_cap = pool.capacity_blocks * self.kv_block
                     self.table_buckets = pow2_buckets(pool.capacity_blocks)
@@ -531,6 +610,7 @@ class DecodeScheduler:
             attn = {key: abstract_states[key] for key in attn_keys}
             pool = KVPool(attn, block=self.kv_block,
                           budget_bytes=int(prefix_cache_mb * (1 << 20)),
+                          shard_factor=self.tp,
                           metrics=self.metrics, tracer=self.tracer)
             if attn and pool.capacity_blocks > 0:
                 self.pool = pool
@@ -571,6 +651,25 @@ class DecodeScheduler:
             # contiguous layouts (and the LSTM fallback) materialize the
             # per-slot stripes the abstract pass only described
             self._states = self._init_states()
+        if self.mesh is not None:
+            # place the carried state on the mesh: K/V head-sharded,
+            # everything else replicated. GSPMD propagates these
+            # shardings through every program, so the carried output
+            # stays head-sharded step over step — no resharding ever
+            # (audited: sharding.collective_counts). The paged page
+            # arrays arrive as HOST numpy (above), so each device
+            # receives only its head slice — no single-device transient
+            # of the tp-x-budget pool. Contiguous stripes (below) do
+            # pass through device 0 first, but contiguous mode is by
+            # definition single-chip-scale state
+            self._states = jax.device_put(
+                self._states, state_shardings(self._states, self.mesh))
+            if self.pool is not None and self.pool.storage:
+                # contiguous-mode side pool storage splits on the same
+                # head axis, so restore's block gather never reshards
+                self.pool.storage = jax.device_put(
+                    self.pool.storage,
+                    storage_shardings(self.pool.storage, self.mesh))
         self._jstep = jax.jit(
             self._step_paged_fn if self.paged else self._step_fn)
         # one prefill program per pow2 chunk bucket (the SAME jitted
@@ -598,6 +697,10 @@ class DecodeScheduler:
         self._prefill_next = 0  # round-robin over prefilling slots
         self._emitted_this_iter = 0  # scheduler-thread-only tally
         m = self.metrics
+        if self.tp > 1:
+            # mesh topology for /metrics, the serve banner, and the UI
+            # /serving page (per-device pool bytes are kvpool.py gauges)
+            m.gauge("decode_mesh_devices").set(self.tp)
         self._m_queue_depth = m.gauge("decode_queue_depth")
         self._m_active = m.gauge("decode_active_slots")
         self._m_occupancy = m.histogram("decode_slot_occupancy", lo=1.0,
@@ -632,6 +735,42 @@ class DecodeScheduler:
         # up ON the trace timeline, right where the stall happened
         self._compile_counter = CompileCounter.for_scheduler(self)
         self._compile_seen: Dict[str, int] = {}
+
+    @property
+    def _params(self):
+        """Dispatch-time params: the sharded copies under a mesh, the
+        net's LIVE tree otherwise (a rebound-after-fit() net keeps
+        serving fresh weights — sharded engines must rebuild instead,
+        as the class docstring documents)."""
+        return self._sharded_params if self._sharded_params is not None \
+            else self.net.params
+
+    @property
+    def _variables(self):
+        return self._sharded_variables \
+            if self._sharded_variables is not None else self.net.variables
+
+    # -- host->device placement --------------------------------------------
+    def _dev_array(self, a) -> jax.Array:
+        """A host array as an EXPLICIT device transfer, placed the way
+        the compiled programs expect it: committed-replicated on the
+        mesh under tensor parallelism (argument placement is part of the
+        jit cache key, so warmup and live dispatch MUST place
+        identically or the budgets double), plain ``jnp.asarray``
+        otherwise. `jax.device_put` of an ndarray is explicit under the
+        transfer guard, same contract as `device_index`."""
+        if self._repl is not None:
+            # np.asarray of a HOST ndarray is a no-op normalization, not
+            # a device sync; the device_put is the explicit transfer
+            return jax.device_put(np.asarray(a), self._repl)  # graftlint: disable=JG006
+        return jnp.asarray(a)
+
+    def _dev_index(self, v: int) -> jax.Array:
+        """`analysis.runtime.device_index` under the same mesh-placement
+        contract as `_dev_array`."""
+        if self._repl is not None:
+            return jax.device_put(np.asarray([v], np.int32), self._repl)
+        return device_index(v)
 
     # -- model plumbing ----------------------------------------------------
     def _impl_items(self):
@@ -968,7 +1107,7 @@ class DecodeScheduler:
         # (construction / recovery / drain-swap) while this engine's loop
         # is idle-by-construction (no slot admitted yet), and stop()'s
         # sweep runs after the join. CC005 cannot see that protocol.
-        self._states = self._jzero(self._states, device_index(slot))  # graftlint: disable=CC005
+        self._states = self._jzero(self._states, self._dev_index(slot))  # graftlint: disable=CC005
 
     # -- prefix KV reuse (kvpool.py) ---------------------------------------
     def _try_restore(self, slot: int, seq: _ActiveSeq) -> None:
@@ -992,8 +1131,8 @@ class DecodeScheduler:
         idx = np.full((bucket,), SCRATCH_BLOCK, np.int32)
         idx[:n_blk] = ids
         self._states = self._jrestore(
-            self._states, device_index(slot), jnp.asarray(idx),
-            device_index(n_blk), self.pool.storage)
+            self._states, self._dev_index(slot), self._dev_array(idx),
+            self._dev_index(n_blk), self.pool.storage)
         seq.fed = n_blk * B
         self._m_prefix_hits.inc()
         self._m_prefix_hit_tokens.inc(seq.fed)
@@ -1028,8 +1167,8 @@ class DecodeScheduler:
             idx = np.zeros((b,), np.int32)
             idx[:] = new_ids[off:off + b]
             self.pool.storage = self._jpublish(
-                self._states, device_index(slot),
-                device_index(start + off), jnp.asarray(idx),
+                self._states, self._dev_index(slot),
+                self._dev_index(start + off), self._dev_array(idx),
                 self.pool.storage)
             off += b
 
@@ -1110,8 +1249,8 @@ class DecodeScheduler:
             seq.cow_starved = True
             return False
         src = seq.block_ids[j]
-        self._states = self._jcow(self._states, device_index(src),
-                                  device_index(bid))
+        self._states = self._jcow(self._states, self._dev_index(src),
+                                  self._dev_index(bid))
         seq.block_ids[j] = bid
         seq.shared[j] = False
         self._table[slot, j] = bid
@@ -1228,8 +1367,9 @@ class DecodeScheduler:
         seq.shared = [True] * n_blk
         self._table[slot, :n_blk] = ids
         fed = min(n_blk * B, len(seq.prompt) - 1)
-        self._states = self._jsetpos(self._states, device_index(slot),
-                                     device_index(fed))
+        self._states = self._jsetpos(self._states,
+                                     self._dev_index(slot),
+                                     self._dev_index(fed))
         seq.fed = fed
         seq.written = fed
         self._m_prefix_hits.inc()
@@ -1497,10 +1637,15 @@ class DecodeScheduler:
         ``reclaim_memo`` caches the two-trie-walk reclaimable count for
         one _admit pass — nothing mutates the pool under _cond, so one
         walk per pass is exact, not stale. ``pending_blocks`` is what
-        this pass's earlier admissions will claim when they prefill
-        (they have not allocated yet), so co-admitted prompts cannot
-        jointly overcommit the pool and trigger the admit-then-preempt
-        churn this gate exists to prevent."""
+        this pass's earlier admissions PLUS the already-resident slots'
+        not-yet-allocated prefill blocks will claim (chunked prefill
+        allocates lazily, at most one chunk per iteration, so a freshly
+        admitted prompt's claim lands over the NEXT several passes —
+        without the resident debit, admission races ahead of allocation
+        and triggers exactly the admit-then-preempt churn this gate
+        exists to prevent). Decode-time growth past the prompt is
+        deliberately NOT reserved — that tail is what preempt-and-swap
+        is for."""
         if not self.paged:
             return True
         if not any(s is not None for s in self._slots):
@@ -1514,7 +1659,13 @@ class DecodeScheduler:
         admitted: List[Tuple[int, _ActiveSeq]] = []
         tr = self.tracer
         reclaim_memo: List[Optional[int]] = [None]
-        pending_blocks = 0  # blocks this pass's admissions will claim
+        pending_blocks = 0  # blocks promised but not yet allocated
+        if self.paged:
+            # resident slots' outstanding prefill claims (scheduler-
+            # thread-only reads, same discipline as _step_once)
+            pending_blocks = sum(
+                max(0, self._blocks_for(len(s.prompt)) - len(s.block_ids))
+                for s in self._slots if s is not None)  # graftlint: disable=CC004
         with self._cond:
             blocked = False
             for i in range(self.n_slots):
@@ -1664,17 +1815,17 @@ class DecodeScheduler:
                 # table bucket covers the PADDED chunk end so the
                 # layer's overflow guard never trips on pad lanes
                 probs, self._states = self._jprefill(
-                    self.net.params, self.net.variables,
-                    device_index(i), jnp.asarray(ids),
-                    device_index(n_real),
-                    jnp.asarray(self._table_for(seq.written + bucket)),
+                    self._params, self._variables,
+                    self._dev_index(i), self._dev_array(ids),
+                    self._dev_index(n_real),
+                    self._dev_array(self._table_for(seq.written + bucket)),
                     self._states)
                 seq.written += n_real
             else:
                 probs, self._states = self._jprefill(
-                    self.net.params, self.net.variables,
-                    device_index(i), jnp.asarray(ids),
-                    device_index(n_real), self._states)
+                    self._params, self._variables,
+                    self._dev_index(i), self._dev_array(ids),
+                    self._dev_index(n_real), self._states)
             seq.fed += n_real
             seq.steps += 1
             self._m_prefill_tokens.inc(n_real)
@@ -1746,12 +1897,13 @@ class DecodeScheduler:
                 table = self._table_for(max(s.written + 1
                                             for _, s in fed))
                 probs, new_states = self._jstep(
-                    self.net.params, self.net.variables, jnp.asarray(ids),
-                    jnp.asarray(live), jnp.asarray(table), self._states)
+                    self._params, self._variables, self._dev_array(ids),
+                    self._dev_array(live), self._dev_array(table),
+                    self._states)
             else:
                 probs, new_states = self._jstep(
-                    self.net.params, self.net.variables, jnp.asarray(ids),
-                    jnp.asarray(live), self._states)
+                    self._params, self._variables, self._dev_array(ids),
+                    self._dev_array(live), self._states)
             self._states = new_states
             probs = host_read(probs)
             for i, seq in fed:
@@ -1922,18 +2074,21 @@ class DecodeScheduler:
         as a hang. The supervisor warms every engine it spawns INSIDE
         the recovery/drain window it already owns, so post-swap traffic
         runs on hot caches and the watchdog judges only real stalls."""
-        params, variables = self.net.params, self.net.variables
-        ids = jnp.zeros((self.n_slots,), jnp.int32)
+        params, variables = self._params, self._variables
+        # args go through the SAME placement helpers as live dispatch
+        # (placement is part of the jit cache key: a warmup that placed
+        # differently would compile a parallel family and blow budgets)
+        ids = self._dev_array(np.zeros((self.n_slots,), np.int32))
         # all-masked: every slot's state transition is frozen in-program
         # (and paged writes redirect to the scratch page), so even the
         # discarded outputs never held corrupted rows
-        live = jnp.zeros((self.n_slots,), bool)
-        slot0 = device_index(0)
-        one = device_index(1)
+        live = self._dev_array(np.zeros((self.n_slots,), bool))
+        slot0 = self._dev_index(0)
+        one = self._dev_index(1)
         if self.paged:
             for nb in self.table_buckets:
-                table = jnp.full((self.n_slots, nb), SCRATCH_BLOCK,
-                                 jnp.int32)
+                table = self._dev_array(np.full(
+                    (self.n_slots, nb), SCRATCH_BLOCK, np.int32))
                 self._jstep(params, variables, ids, live, table,
                             self._states)
             # the FULL budgeted prefill family: one program per (chunk
@@ -1945,24 +2100,27 @@ class DecodeScheduler:
             # swap, when the watchdog no longer extends warmup grace
             for b in self.prefill_buckets:
                 for nb in self.table_buckets:
-                    table = jnp.full((self.n_slots, nb), SCRATCH_BLOCK,
-                                     jnp.int32)
+                    table = self._dev_array(np.full(
+                        (self.n_slots, nb), SCRATCH_BLOCK, np.int32))
                     self._jprefill(params, variables, slot0,
-                                   jnp.zeros((b,), jnp.int32), one,
-                                   table, self._states)
-            self._jsetpos(self._states, slot0, device_index(0))
-            self._jcow(self._states, device_index(SCRATCH_BLOCK),
-                       device_index(SCRATCH_BLOCK))
+                                   self._dev_array(np.zeros((b,),
+                                                            np.int32)),
+                                   one, table, self._states)
+            self._jsetpos(self._states, slot0, self._dev_index(0))
+            self._jcow(self._states, self._dev_index(SCRATCH_BLOCK),
+                       self._dev_index(SCRATCH_BLOCK))
         else:
             self._jstep(params, variables, ids, live, self._states)
             for b in self.prefill_buckets:
                 self._jprefill(params, variables, slot0,
-                               jnp.zeros((b,), jnp.int32), one,
-                               self._states)
+                               self._dev_array(np.zeros((b,),
+                                                        np.int32)),
+                               one, self._states)
             if self.pool is not None:
                 for b in self.restore_buckets:
                     idx = np.full((b,), SCRATCH_BLOCK, np.int32)
-                    self._jrestore(self._states, slot0, jnp.asarray(idx),
+                    self._jrestore(self._states, slot0,
+                                   self._dev_array(idx),
                                    one, self.pool.storage)
                     # publish donates its storage argument — rebind, or
                     # the pool would be left pointing at consumed
@@ -1970,8 +2128,9 @@ class DecodeScheduler:
                     # rows into unallocated block 0 is harmless: any
                     # future insert() scatters real data over it.
                     self.pool.storage = self._jpublish(
-                        self._states, slot0, device_index(0),
-                        jnp.zeros((b,), jnp.int32), self.pool.storage)
+                        self._states, slot0, self._dev_index(0),
+                        self._dev_array(np.zeros((b,), np.int32)),
+                        self.pool.storage)
         self._jzero(self._states, slot0)
 
     def shed_queued(self, target_depth: int) -> int:

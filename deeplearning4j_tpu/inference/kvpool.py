@@ -122,16 +122,25 @@ class KVPool:
     its jitted state pytree, where the programs scatter/gather them);
     this object allocates NOTHING on device and becomes pure metadata —
     free list, trie, refcounts — plus the ``kv_pool_*`` gauges.
+
+    ``shard_factor``: tensor-parallel device count when the K/V head
+    axis is sharded over a mesh (`inference/sharding.py`). Each device
+    then holds only ``Hkv / shard_factor`` heads of every block, so
+    ``budget_bytes`` is the PER-DEVICE byte budget and
+    ``bytes_per_block`` the per-device cost — at fixed per-device HBM a
+    ``tp``-wide mesh holds ``tp×`` the blocks. The block/trie/refcount
+    metadata is device-count-agnostic (one logical pool).
     """
 
     def __init__(self, attn_states: Dict, *, block: int, budget_bytes: int,
-                 paged: bool = False,
+                 paged: bool = False, shard_factor: int = 1,
                  metrics: Optional[MetricsRegistry] = None,
                  tracer: Optional[FlightRecorder] = None):
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
         self.block = int(block)
         self.paged = bool(paged)
+        self.shard_factor = max(1, int(shard_factor))
         # flight recorder (trace.py): eviction/publish instants on the
         # `kvpool` track; None (standalone pool) records nothing
         self._tracer = tracer
@@ -144,6 +153,10 @@ class KVPool:
             shapes[key] = (row_shape, dtype)
             per_block += 2 * self.block * int(jnp.dtype(dtype).itemsize) \
                 * int(math.prod(row_shape))
+        # per-DEVICE block cost: the head axis splits evenly over the
+        # mesh (the engine refuses to shard otherwise), so a block costs
+        # each device 1/shard_factor of its total bytes
+        per_block = per_block // self.shard_factor
         self.bytes_per_block = per_block
         total = self.budget_bytes // per_block if per_block else 0
         # one block of the budget is the scratch row
@@ -159,7 +172,7 @@ class KVPool:
         self._root = _Node((), SCRATCH_BLOCK, None)
         self._clock = 0  # logical LRU clock (monotonic per pool op)
         self._metrics = metrics
-        self._g_live = self._g_free = None
+        self._g_live = self._g_free = self._g_dev_used = None
         if metrics is not None:
             self._m_evicted = metrics.counter(
                 "prefix_cache_evicted_blocks_total")
@@ -173,6 +186,13 @@ class KVPool:
                 cap_g = metrics.gauge("kv_pool_blocks_capacity")
                 cap_g.set(self.capacity_blocks)
                 metrics.ratio("kv_pool_utilization", self._g_live, cap_g)
+                # per-DEVICE pool footprint (scratch included): under a
+                # tp mesh each device holds its head slice of every
+                # page, so used bytes track utilization per device
+                metrics.gauge("kv_pool_device_bytes").set(
+                    (self.capacity_blocks + 1) * self.bytes_per_block)
+                self._g_dev_used = metrics.gauge(
+                    "kv_pool_device_used_bytes")
                 self._sync_gauges()
             else:
                 self._m_used = metrics.gauge("prefix_cache_used_bytes")
@@ -189,6 +209,7 @@ class KVPool:
         if self._g_live is not None:
             self._g_live.set(self.used_blocks)
             self._g_free.set(len(self._free))
+            self._g_dev_used.set(self.used_blocks * self.bytes_per_block)
 
     @property
     def free_blocks(self) -> int:

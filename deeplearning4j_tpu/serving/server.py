@@ -36,6 +36,13 @@ up front with HTTP 413 (counted in `decode_rejected_total`) instead of
 dying mid-decode on the attention layer's overflow guard — contiguous
 mode bounds on ``max_cache_len``, paged mode only on the WHOLE pool
 (the 413 body then reports ``blocks_needed`` vs ``blocks_available``).
+``decode_tp`` (`--tp N`) shards the decode engine tensor-parallel over
+an N-device mesh (`inference/sharding.py`): attention heads / FFN
+hidden dims split across the ``tp`` axis, the KV pool shards by head
+(``kv_pool_mb`` becomes the PER-DEVICE budget — N× the blocks at fixed
+per-device HBM), and the mesh topology + per-device pool bytes surface
+as ``decode_mesh_devices`` / ``kv_pool_device_bytes`` gauges in
+`GET /metrics`, `GET /info`, and the UI `/serving` page.
 
 Observability (`inference/trace.py`): the server owns a span flight
 recorder written from the HTTP layer, batcher, decode scheduler, and KV
@@ -138,7 +145,7 @@ class InferenceServer:
                  decode_vocab: Optional[int] = None, decode_slots: int = 4,
                  prefill_chunk: int = 64, decode_queue: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
-                 kv_pool_mb: float = 0.0,
+                 kv_pool_mb: float = 0.0, decode_tp: int = 0,
                  metrics: Optional[MetricsRegistry] = None,
                  trace_buffer: int = 8192,
                  tracer: Optional[FlightRecorder] = None,
@@ -166,6 +173,13 @@ class InferenceServer:
         self.prefix_cache_mb = float(prefix_cache_mb)
         self.kv_block = int(kv_block)
         self.kv_pool_mb = float(kv_pool_mb)
+        # tensor-parallel decode (inference/sharding.py): > 1 shards the
+        # engine over a tp-device mesh — heads/FFN split, KV pool
+        # head-sharded (kv_pool_mb becomes the PER-DEVICE budget), block
+        # tables replicated. 0/1 = single-device. The factory passes it
+        # through on every (re)build, so crash recovery and draining
+        # restarts come back sharded too.
+        self.decode_tp = int(decode_tp)
         # fault tolerance (inference/supervisor.py): the decode engine
         # is owned by an EngineSupervisor — watchdog, crash recovery
         # with request requeue, degradation ladder, draining restarts —
@@ -222,6 +236,7 @@ class InferenceServer:
             prefix_cache_mb=self.prefix_cache_mb,
             kv_block=self.kv_block,
             kv_pool_mb=self.kv_pool_mb,
+            mesh=self.decode_tp if self.decode_tp > 1 else None,
             transfer_guard=self.decode_transfer_guard,
             metrics=self.metrics, tracer=self.tracer)
 
@@ -382,10 +397,14 @@ class InferenceServer:
                     self._send({"armed": failpoints.snapshot(),
                                 "seams": list(failpoints.SEAMS)})
                 elif url.path == "/info":
+                    import jax  # mesh topology: visible vs used devices
+                    dec = server._decoder
                     self._send({"model": type(server.net).__name__,
                                 "config": json.loads(server.net.conf.to_json()),
                                 "params": server.net.num_params(),
-                                "batching": server.batching})
+                                "batching": server.batching,
+                                "mesh": {"devices": len(jax.devices()),
+                                         "tp": getattr(dec, "tp", 1)}})
                 elif url.path == "/metrics":
                     q = parse_qs(url.query)
                     if q.get("format", [""])[0] == "text":

@@ -281,6 +281,7 @@ _SERVING_PAGE = """<!DOCTYPE html>
 <h2>Serving SLO metrics</h2>
 <div id="meta"></div>
 <div id="decode" style="color:#555"></div>
+<div id="mesh" style="color:#555"></div>
 <div id="kvpool" style="color:#555"></div>
 <div id="robust" style="color:#555"></div>
 <div id="trace" style="font-family:monospace;font-size:12px"></div>
@@ -346,6 +347,13 @@ async function refresh() {
       (c.decode_cancelled_total ? ', ' + c.decode_cancelled_total +
         ' cancelled' : '');
   const g = m.gauges || {};
+  if (g.decode_mesh_devices)  // tensor-parallel mesh topology line
+    document.getElementById('mesh').innerText =
+      'mesh: tensor-parallel over ' + g.decode_mesh_devices.value +
+      ' devices (tp axis, KV pool head-sharded)' +
+      (g.kv_pool_device_bytes ? ', ' +
+        ((g.kv_pool_device_used_bytes || {}).value || 0) + ' / ' +
+        g.kv_pool_device_bytes.value + ' KV bytes per device' : '');
   if (g.kv_pool_blocks_capacity)  // paged KV pool occupancy line
     document.getElementById('kvpool').innerText =
       'kv pool: ' + (g.kv_pool_blocks_live ?

@@ -22,16 +22,48 @@ aborting; per-request retry counts (and server-side engine-restart
 recoveries, the ``retries`` field in /generate responses) are reported
 at the end.
 
+Sharded serving (ISSUE 9): `--generate --mesh N` runs the decode engine
+tensor-parallel over an N-device mesh (heads/FFN sharded over the `tp`
+axis, paged KV pool head-sharded with a PER-DEVICE byte budget) and
+reports tokens/s — the reproducible-from-the-example form of
+`bench.py`'s `sharded_decode` row. On CPU the flag forces
+`--xla_force_host_platform_device_count=N` for you.
+
     python examples/serving_load_test.py            # batched only
     python examples/serving_load_test.py --compare  # batched vs serialized
     python examples/serving_load_test.py --generate --trace-out trace.json
+    python examples/serving_load_test.py --generate --mesh 4
 """
 import argparse
 import json
+import os
+import sys
 import threading
 import time
 import urllib.error
 import urllib.request
+
+def _mesh_arg(argv):
+    """The --mesh value, handling both '--mesh N' and '--mesh=N' (None
+    when absent or malformed — argparse reports the error later)."""
+    for i, a in enumerate(argv):
+        if a == "--mesh" and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith("--mesh="):
+            return a.split("=", 1)[1]
+    return None
+
+
+_n = _mesh_arg(sys.argv[1:])
+if _n and _n.isdigit():
+    # must happen BEFORE jax initializes (the imports below pull it in):
+    # N virtual host devices so the tp mesh exists on plain CPU. Unlike
+    # conftest.py/bench.py (which only fill an ABSENT flag), a smaller
+    # pre-existing count is REPLACED — the user asked for exactly N
+    _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+              if "xla_force_host_platform_device_count" not in f]
+    _flags.append(f"--xla_force_host_platform_device_count={_n}")
+    os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 import numpy as np
 
@@ -121,7 +153,8 @@ def _drive(server, n_threads, reqs_each, body):
 def _make_lm(vocab=32, cache=96):
     from deeplearning4j_tpu.models.zoo import transformer_lm
     from deeplearning4j_tpu.nn.graph import ComputationGraph
-    conf = transformer_lm(vocab_size=vocab, d_model=32, n_heads=2,
+    # 4 KV heads so --mesh 2/4 can shard the cache by head
+    conf = transformer_lm(vocab_size=vocab, d_model=32, n_heads=4,
                           n_blocks=2, rope=True)
     for vert in conf.vertices.values():
         layer = getattr(vert, "layer", None)
@@ -131,13 +164,17 @@ def _make_lm(vocab=32, cache=96):
 
 
 def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
-                  trace_out=None, verbose=True):
-    """Drive POST /generate and show where each request's time went."""
+                  trace_out=None, mesh=0, verbose=True):
+    """Drive POST /generate and show where each request's time went.
+    ``mesh`` > 1: tensor-parallel decode over that many devices, paged
+    KV pool (per-device budget) instead of the contiguous prefix
+    cache."""
     vocab = 32
     net = _make_lm(vocab, cache=prompt_len + new_tokens)
+    kw = (dict(kv_pool_mb=4.0, decode_tp=mesh) if mesh and mesh > 1
+          else dict(prefix_cache_mb=16))
     srv = InferenceServer(net=net, decode_vocab=vocab, decode_slots=4,
-                          prefill_chunk=16, prefix_cache_mb=16,
-                          kv_block=8).start()
+                          prefill_chunk=16, kv_block=8, **kw).start()
     rng = np.random.default_rng(0)
     results, errors, retry_counts = [], [], []
     # prompts pre-built on the main thread (numpy Generators are not
@@ -178,13 +215,26 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
                 f"http://127.0.0.1:{srv.port}/trace?format=chrome").read())
             with open(trace_out, "w") as fh:
                 json.dump(trace, fh)
+        tp_used = getattr(srv._decoder, "tp", 1)  # before stop() drops it
     finally:
         srv.stop()
     assert not errors, errors
     if verbose:
         tok_s = len(results) * new_tokens / elapsed
         retried = sum(1 for n in retry_counts if n)
-        print(f"generate:   {len(results)} requests, {tok_s:8.1f} tok/s"
+        if mesh and mesh > 1:
+            # report the engine's ACTUAL tp (the scheduler disables
+            # sharding with a warning when heads don't divide) — same
+            # honesty contract as the CLI banner
+            if tp_used > 1:
+                print(f"mesh:       tensor-parallel over {tp_used} "
+                      "devices (tp axis), paged KV pool head-sharded, "
+                      "per-device budget")
+            else:
+                print(f"mesh:       --mesh {mesh} requested but sharding "
+                      "is DISABLED (see the engine warning above); "
+                      "single-device numbers follow")
+        print(f"generate:   {len(results)} requests, {tok_s:8.1f} tokens/s"
               + (f"  (HTTP retries: {sum(retry_counts)} across {retried} "
                  f"request(s), max {max(retry_counts)})"
                  if retried else ""))
@@ -259,10 +309,15 @@ if __name__ == "__main__":
     ap.add_argument("--trace-out", default=None,
                     help="with --generate: dump the flight recorder as "
                          "Chrome trace-event JSON (Perfetto-loadable)")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="with --generate: shard the decode engine "
+                         "tensor-parallel over N devices (forces an "
+                         "N-device virtual CPU mesh when needed) and "
+                         "report tokens/s")
     a = ap.parse_args()
     if a.generate:
         main_generate(n_threads=a.threads, reqs_each=a.requests,
-                      trace_out=a.trace_out)
+                      trace_out=a.trace_out, mesh=a.mesh)
     else:
         main(n_threads=a.threads, reqs_each=a.requests, rows=a.rows,
              compare=a.compare)

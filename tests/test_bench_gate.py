@@ -85,3 +85,33 @@ def test_trace_overhead_regression_is_caught():
 def test_trace_overhead_healthy_row_passes():
     rows = {"trace_overhead": {"throughput_ratio": 0.995}}
     assert bench.check_floors(rows) == []
+
+
+def test_sharded_decode_regressions_are_caught():
+    """ISSUE 9 acceptance floors: effective slots at fixed per-device
+    HBM must scale >= 2x at 4 devices, outputs must stay token-identical
+    to the 1-device engine, and a resharding collective appearing on the
+    per-token decode program (a sharding choice disagreeing with the
+    dataflow) must trip the gate."""
+    rows = {"sharded_decode": {"effective_slots_ratio_4dev": 1.2,
+                               "outputs_identical": 1,
+                               "resharding_collectives": 0}}
+    regs = bench.check_floors(rows)
+    assert any("effective_slots_ratio_4dev" in r for r in regs), regs
+    rows = {"sharded_decode": {"effective_slots_ratio_4dev": 4.0,
+                               "outputs_identical": 0,
+                               "resharding_collectives": 0}}
+    regs = bench.check_floors(rows)
+    assert any("outputs_identical" in r for r in regs), regs
+    rows = {"sharded_decode": {"effective_slots_ratio_4dev": 4.0,
+                               "outputs_identical": 1,
+                               "resharding_collectives": 2}}
+    regs = bench.check_floors(rows)
+    assert any("resharding_collectives" in r for r in regs), regs
+
+
+def test_sharded_decode_healthy_row_passes():
+    rows = {"sharded_decode": {"effective_slots_ratio_4dev": 3.2,
+                               "outputs_identical": 1,
+                               "resharding_collectives": 0}}
+    assert bench.check_floors(rows) == []

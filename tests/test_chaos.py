@@ -372,3 +372,50 @@ def test_runtime_happens_before_checker_zero_violations_under_chaos():
             srv.stop()
     assert det.violations == [], det.format_violations()
     assert det.tracking  # armed throughout, not fast-pathed
+
+
+def test_sharded_engine_crash_recovery_token_identical():
+    """The chaos invariants survive the mesh (ISSUE 9): a supervised
+    tensor-parallel engine (tp=2, paged head-sharded pool) crashed by an
+    armed decode-dispatch seam is fenced, rebuilt SHARDED (the factory
+    re-passes decode_tp), warmed across the sharded program family, and
+    replays every in-flight request token-identically — no loss, no
+    double-finish, budgets clean after the restart."""
+    conf = transformer_lm(vocab_size=V, d_model=32, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = 96
+    net = ComputationGraph(conf).init()
+    srv = InferenceServer(net=net, decode_vocab=V, decode_slots=2,
+                          prefill_chunk=16, kv_pool_mb=1.0, kv_block=8,
+                          decode_tp=2, hang_timeout_s=30.0,
+                          retry_budget=6,
+                          decode_transfer_guard="disallow").start()
+    srv.supervisor.backoff_base_s = 0.01
+    srv.supervisor.backoff_max_s = 0.1
+    try:
+        assert srv.supervisor.engine.tp == 2
+        assert srv.supervisor.engine.paged
+        prompts = _mk_prompts()
+        expected = [o["tokens"] for o in _drive_generate(srv, prompts)]
+        failpoints.arm("dispatch.decode", "crash@once")
+        try:
+            outs = _drive_generate(srv, prompts)
+        finally:
+            failpoints.disarm()
+        _await_ready(srv)
+        assert [o["tokens"] for o in outs] == expected
+        assert any(o.get("retries") for o in outs), \
+            "no request reports surviving the restart"
+        assert srv.supervisor.restarts >= 1
+        # the REBUILT engine is sharded too, with clean budgets
+        assert srv.supervisor.engine.tp == 2
+        assert srv.supervisor.engine._compile_counter.check() == []
+        dups = {rid: n for rid, n in
+                _finish_counts(srv.tracer).items() if n > 1}
+        assert not dups
+    finally:
+        failpoints.disarm()
+        srv.stop()
