@@ -171,6 +171,32 @@ class CompileCounter:
         jcow = getattr(scheduler, "_jcow", None)
         if jcow is not None:
             c.track("block_cow", jcow, budget=1)
+        # speculative decoding (ISSUE 10): the verify program mirrors
+        # decode's bucketing (<=1 per table bucket, one fixed gamma+1
+        # chain width — pow2-gamma callers each get their own engine,
+        # so the per-engine family is <=1 per bucket); the draft's
+        # step/prefill/zero mirror the main families over the draft
+        # state pytree; the two fixpos rollback programs are singletons.
+        # All budgets are mesh-size-invariant like the rest.
+        jverify = getattr(scheduler, "_jverify", None)
+        if jverify is not None:
+            c.track("spec_verify", jverify,
+                    budget=max(1, tb) if paged else 1)
+        jdstep = getattr(scheduler, "_jdraft_step", None)
+        if jdstep is not None:
+            c.track("draft_decode", jdstep, budget=1)
+        jdprefill = getattr(scheduler, "_jdraft_prefill", None)
+        if jdprefill is not None:
+            c.track("draft_prefill", jdprefill, budget=pf)
+        jdzero = getattr(scheduler, "_jdraft_zero", None)
+        if jdzero is not None:
+            c.track("draft_reset", jdzero, budget=1)
+        jfixpos = getattr(scheduler, "_jfixpos", None)
+        if jfixpos is not None:
+            c.track("spec_fixpos", jfixpos, budget=1)
+        jdfixpos = getattr(scheduler, "_jdraft_fixpos", None)
+        if jdfixpos is not None:
+            c.track("draft_fixpos", jdfixpos, budget=1)
         return c
 
 

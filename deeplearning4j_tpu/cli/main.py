@@ -15,7 +15,9 @@ Usage:
                    [--trace-buffer N]
                    [--generate [--vocab-size V] [--decode-slots N]
                     [--prefill-chunk C] [--kv-pool-mb MB]
-                    [--prefix-cache-mb MB] [--kv-block B]]
+                    [--prefix-cache-mb MB] [--kv-block B]
+                    [--kv-dtype int8] [--speculate GAMMA]
+                    [--draft-blocks K] [--tp N]]
                    [--no-supervise] [--hang-timeout S] [--retry-budget N]
                    [--failpoint NAME=SPEC ...] [--failpoint-endpoint]
 """
@@ -113,7 +115,10 @@ def cmd_serve(args) -> int:
               prefix_cache_mb=args.prefix_cache_mb,
               kv_block=args.kv_block,
               kv_pool_mb=args.kv_pool_mb,
+              kv_dtype=args.kv_dtype,
               decode_tp=args.tp,
+              speculate=args.speculate,
+              draft_blocks=args.draft_blocks,
               trace_buffer=args.trace_buffer,
               supervise=not args.no_supervise,
               hang_timeout_s=args.hang_timeout,
@@ -145,16 +150,19 @@ def cmd_serve(args) -> int:
         net = restore_model(args.model)
         mode = "float"
     if args.generate:
-        if mode == "int8":
-            # DecodeScheduler drives the float forward impls + KV cache;
-            # the quantized program has neither
-            print("error: --generate is not supported with --int8 "
-                  "(the decode scheduler needs the float model)",
-                  file=sys.stderr)
+        if mode == "int8" and not hasattr(net.conf, "vertices"):
+            # the decode scheduler drives ComputationGraph decode (KV
+            # cache states); a multilayer QuantizedNetwork has neither —
+            # quantize the LM with quantize_graph/save_quantized_graph
+            print("error: --int8 --generate needs a quantized "
+                  "ComputationGraph artifact (nn.quantization."
+                  "save_quantized_graph); this zip holds a multilayer "
+                  "one", file=sys.stderr)
             return 2
         # the LM's next-token head width IS the vocabulary; --vocab-size
         # only exists for models whose output layer is wider than the
-        # token space actually served
+        # token space actually served. An int8 graph clone keeps the
+        # float conf, so the inference below works for both modes.
         if args.vocab_size:
             kw["decode_vocab"] = args.vocab_size
         elif hasattr(net.conf, "vertices"):  # ComputationGraph facade
@@ -183,17 +191,28 @@ def cmd_serve(args) -> int:
                      "head-sharded, per-device budgets)")
     else:
         mesh_mode = ""
+    # speculation: report the ENGINE's armed state (disabled with a
+    # RuntimeWarning when the model cannot be draft-cut), not the flag
+    spec_on = int(getattr(decoder, "speculate", 0))
+    if spec_on:
+        spec_mode = (f", speculative x{spec_on} (shallow-exit draft, "
+                     f"{getattr(decoder, 'draft_blocks', 0)} blocks)")
+    else:
+        spec_mode = ""
     if paged_on:
         kv_mode = (f", paged KV pool {args.kv_pool_mb}MB "
                    f"({decoder.pool.capacity_blocks} blocks of "
-                   f"{args.kv_block})")
+                   f"{args.kv_block}"
+                   + (", int8 KV" if getattr(decoder, "kv_dtype", None)
+                      else "") + ")")
     elif pool_on:
         kv_mode = (f", prefix cache {args.prefix_cache_mb}MB "
                    f"(block {args.kv_block})")
     else:
         kv_mode = ", prefix cache OFF"
     gen_mode = (f"; /generate: {args.decode_slots} slots, "
-                f"prefill chunk {args.prefill_chunk}" + kv_mode + mesh_mode
+                f"prefill chunk {args.prefill_chunk}" + kv_mode
+                + spec_mode + mesh_mode
                 + (f", supervised (hang timeout {args.hang_timeout}s, "
                    f"retry budget {args.retry_budget})"
                    if not args.no_supervise else ", UNSUPERVISED")
@@ -312,6 +331,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="positions per KV block, paged pool and prefix "
                         "cache alike (only full blocks of a prompt are "
                         "shared)")
+    s.add_argument("--kv-dtype", choices=["int8"], default=None,
+                   help="quantize the PAGED KV pool's pages to int8 "
+                        "(per-row max-abs scales; less than half the "
+                        "bytes per block, so the same --kv-pool-mb "
+                        "holds 2x+ the blocks; paged mode only)")
+    s.add_argument("--speculate", type=int, default=0, metavar="GAMMA",
+                   help="speculative decoding: draft GAMMA tokens per "
+                        "slot per iteration with a shallow-exit draft "
+                        "and verify them in one multi-token forward — "
+                        "output stays token-identical to GAMMA=0 by "
+                        "construction (0 = off)")
+    s.add_argument("--draft-blocks", type=int, default=0, metavar="K",
+                   help="transformer blocks the self-speculative draft "
+                        "runs before early-exiting through the output "
+                        "head (default: half the model's blocks)")
     s.add_argument("--trace-buffer", type=int, default=8192,
                    help="span flight-recorder ring capacity (events) "
                         "backing GET /trace and per-request timings; "

@@ -30,18 +30,23 @@ from .kvpool import KVPool
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry)
 from .sharding import (TP_AXIS, collective_counts, decode_mesh,
-                       decode_program_hlo, prefill_program_hlo)
+                       decode_program_hlo, draft_program_hlo,
+                       prefill_program_hlo, verify_program_hlo)
+from .speculative import ForkGroup, build_shallow_draft
 from .supervisor import (AdmissionRejectedError, EngineSupervisor,
                          RetryBudgetExceededError, ShuttingDownError)
 from .trace import FlightRecorder, default_recorder, new_request_id
 
 __all__ = ["AdmissionRejectedError", "Counter", "DecodeHandle",
            "DecodeScheduler", "EngineCrashedError", "EngineSupervisor",
-           "FlightRecorder", "Gauge", "Histogram", "InferenceFuture",
+           "FlightRecorder", "ForkGroup", "Gauge", "Histogram",
+           "InferenceFuture",
            "InjectedCrash", "InjectedFault", "InjectedHang", "InjectedOOM",
            "KVPool", "LoadSheddedError", "MetricsRegistry", "MicroBatcher",
            "PromptTooLongError", "QueueFullError", "RequestTimeoutError",
            "RetryBudgetExceededError", "ShuttingDownError", "TP_AXIS",
-           "bucket_for", "collective_counts", "decode_mesh",
-           "decode_program_hlo", "default_recorder", "default_registry",
-           "new_request_id", "pow2_buckets", "prefill_program_hlo"]
+           "bucket_for", "build_shallow_draft", "collective_counts",
+           "decode_mesh", "decode_program_hlo", "default_recorder",
+           "default_registry", "draft_program_hlo",
+           "new_request_id", "pow2_buckets", "prefill_program_hlo",
+           "verify_program_hlo"]

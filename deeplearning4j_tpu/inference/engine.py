@@ -103,11 +103,13 @@ from ..nn.layers.recurrent import (BaseRecurrentImpl,
 from ..nn.multilayer import _compute_dtype_of
 from . import failpoints
 from .batcher import QueueFullError, bucket_for, pow2_buckets
-from .kvpool import SCRATCH_BLOCK, KVPool, gather_blocks, scatter_blocks
+from .kvpool import (PAGE_KEYS, SCRATCH_BLOCK, KVPool, gather_blocks,
+                     scatter_blocks)
 from .metrics import MetricsRegistry, default_registry
 from .sharding import (TP_AXIS, decode_mesh, kv_heads_shardable,
                        shard_decode_params, state_shardings,
                        storage_shardings)
+from .speculative import ForkGroup, accept_tokens, build_shallow_draft
 from .trace import FlightRecorder, default_recorder, new_request_id
 
 # chunk buckets never go below this (a 3-token tail still pads to one
@@ -261,7 +263,7 @@ class _ActiveSeq:
     __slots__ = ("handle", "prompt", "fed", "rng", "temperature", "top_k",
                  "top_p", "eos_id", "steps", "pool_node", "block_ids",
                  "shared", "written", "phase", "resumed", "folded",
-                 "cow_starved")
+                 "cow_starved", "fork", "draft_fed")
 
     def __init__(self, handle: DecodeHandle, prompt: Sequence[int],
                  temperature: float, top_k: Optional[int],
@@ -293,6 +295,32 @@ class _ActiveSeq:
         # a shared block — without this a full-pool full-prompt hit
         # would preempt/restore/starve forever
         self.cow_starved = False
+        # -- best-of-n fork group (speculative.ForkGroup, or None) --
+        self.fork = None
+        # -- speculative decoding: tokens of `full_context()` the DRAFT
+        # net has ingested (its contiguous cache row count / pos mirror)
+        self.draft_fed = 0
+
+    def full_context(self) -> List[int]:
+        """Every token the sequence is conditioned on so far (prompt —
+        which absorbs preempt-folded generations — plus the unfolded
+        generated tail). The draft net's catch-up target."""
+        return self.prompt + self.handle.tokens[self.folded:]
+
+    def known_tokens(self) -> int:
+        """len(full_context()) without building the list."""
+        return len(self.prompt) + len(self.handle.tokens) - self.folded
+
+    def tail_context(self, k: int) -> List[int]:
+        """The last ``k`` tokens of `full_context` as an O(k) slice —
+        the speculative lockstep only ever feeds the trailing lag<=2
+        tokens, and copying a multi-thousand-token context per slot per
+        iteration onto the hot path would tax the very loop speculation
+        exists to speed up."""
+        gen = self.handle.tokens[self.folded:] if k > 0 else []
+        if len(gen) >= k:
+            return gen[len(gen) - k:]
+        return self.prompt[len(self.prompt) - (k - len(gen)):] + gen
 
     def next_input(self) -> int:
         """Token to feed this step: the next prompt token while prefilling,
@@ -371,6 +399,27 @@ class DecodeScheduler:
     ``net`` — it holds sharded param COPIES, so a live-trained net's
     updates stop reaching a sharded engine (rebuild to pick them up).
 
+    ``speculate``: speculative decoding (ISSUE 10). ``G > 0`` drafts G
+    tokens per decode-ready slot per iteration with a cheap draft model
+    and verifies them in ONE multi-token target forward; acceptance
+    samples each position from the TARGET distribution with the
+    sequence's own RNG, so output is token-identical to ``G = 0`` by
+    construction — only tokens/s changes (multiplicatively on
+    high-acceptance traffic, mildly negative on adversarially random
+    traffic). ``draft_blocks``: depth of the default SELF-speculative
+    draft — the target's first K transformer blocks rewired into its
+    own output head, params shared by reference (default: half the
+    blocks). ``draft_net``: an explicit draft ComputationGraph instead
+    (same vocab/head contract); required for models the shallow-exit
+    surgery cannot cut (non-zoo graph shapes disable speculation with
+    a RuntimeWarning).
+
+    ``kv_dtype``: ``"int8"`` quantizes the PAGED pool's page arrays
+    (per-(position, head) max-abs scales stored alongside; quantize on
+    write, dequantize on gather) — less than half the bytes per block,
+    so a fixed ``kv_pool_mb`` holds 2x+ the blocks. Lossy: decode is
+    plausible but not bit-identical to the f32 cache. Paged mode only.
+
     ``transfer_guard``: device-residency audit mode. When set (e.g.
     "disallow"), every scheduler iteration runs under that thread-local
     ``jax.transfer_guard`` level: any *implicit* host<->device transfer in
@@ -383,12 +432,17 @@ class DecodeScheduler:
     def __init__(self, net, vocab_size: int, *, n_slots: int = 4,
                  max_queue: int = 64, prefill_chunk: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
-                 kv_pool_mb: float = 0.0, mesh=None,
+                 kv_pool_mb: float = 0.0, kv_dtype: Optional[str] = None,
+                 mesh=None, speculate: int = 0,
+                 draft_blocks: Optional[int] = None, draft_net=None,
                  metrics: Optional[MetricsRegistry] = None,
                  tracer: Optional[FlightRecorder] = None,
                  transfer_guard: Optional[str] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
         self.net = net
         self.vocab_size = int(vocab_size)
         self.n_slots = int(n_slots)
@@ -523,6 +577,7 @@ class DecodeScheduler:
         #     restored by jitted block-gather (the ISSUE 4 layout, kept
         #     as the token-identity reference)
         self.kv_block = int(kv_block)
+        self.kv_dtype: Optional[str] = None  # set when int8 KV engages
         self.pool: Optional[KVPool] = None
         self.paged = False
         self.restore_buckets: List[int] = []
@@ -537,7 +592,7 @@ class DecodeScheduler:
                 attn = {key: abstract_states[key] for key in attn_keys}
                 pool = KVPool(attn, block=self.kv_block, paged=True,
                               budget_bytes=int(kv_pool_mb * (1 << 20)),
-                              shard_factor=self.tp,
+                              shard_factor=self.tp, cache_dtype=kv_dtype,
                               metrics=self.metrics, tracer=self.tracer)
                 if pool.capacity_blocks > 0:
                     self.pool = pool
@@ -564,9 +619,32 @@ class DecodeScheduler:
                             lambda s: zeros(s.shape, s.dtype), st)
                         for key, st in abstract_states.items()
                         if key not in attn_keys}
+                    self.kv_dtype = kv_dtype
                     for key in attn_keys:
                         st = abstract_states[key]
                         tail = st["k"].shape[2:]
+                        if kv_dtype == "int8":
+                            # quantized pages (int8 values + f32 per-row
+                            # scales: attention quantizes on write and
+                            # dequantizes on gather — halved-plus pool
+                            # bytes per block, same paged step contract)
+                            self._states[key] = {
+                                "k_pages": zeros(
+                                    (pages, self.kv_block) + tail,
+                                    jnp.int8),
+                                "v_pages": zeros(
+                                    (pages, self.kv_block) + tail,
+                                    jnp.int8),
+                                "k_scales": zeros(
+                                    (pages, self.kv_block) + tail[:-1],
+                                    jnp.float32),
+                                "v_scales": zeros(
+                                    (pages, self.kv_block) + tail[:-1],
+                                    jnp.float32),
+                                "pos": zeros(st["pos"].shape,
+                                             st["pos"].dtype),
+                            }
+                            continue
                         self._states[key] = {
                             "k_pages": zeros(
                                 (pages, self.kv_block) + tail,
@@ -598,6 +676,12 @@ class DecodeScheduler:
                     "the paged pool IS the prefix cache (finished "
                     "prompts' blocks are adopted by the trie in place, "
                     "zero-copy)", RuntimeWarning, stacklevel=2)
+        if kv_dtype and not self.kv_dtype:
+            warnings.warn(
+                "kv_dtype='int8' requested but the paged KV pool did not "
+                "engage (int8 KV quantization lives in the pool's page "
+                "arrays); serving with the model-dtype cache instead",
+                RuntimeWarning, stacklevel=2)
         # NOT elif: when kv_pool_mb was requested but paged could not
         # engage, a configured prefix_cache_mb must still buy the
         # contiguous side pool — silently dropping BOTH knobs would
@@ -694,6 +778,94 @@ class DecodeScheduler:
             # the occasional copy-on-write block duplication (one more)
             self._jsetpos = jax.jit(self._setpos_fn)
             self._jcow = jax.jit(self._cow_fn)
+        # -- speculative decoding (ISSUE 10 tentpole) ----------------------
+        # a cheap draft proposes `speculate` tokens per decode-ready slot
+        # per iteration; ONE multi-token verify program (the chunked-
+        # prefill forward with every position's logits retained) scores
+        # all gamma+1 positions, and `speculative.accept_tokens` keeps the
+        # longest prefix the target's own sampling confirms — output is
+        # token-identical to solo decode by construction. Rejected rows
+        # roll back via pos (and paged block-table truncation); the draft
+        # is a self-speculative shallow exit over the first `draft_blocks`
+        # transformer blocks unless an explicit `draft_net` is passed.
+        self.speculate = 0
+        self.draft = None
+        self.draft_blocks = 0
+        self._draft_states = None
+        self._draft_cap: Optional[int] = None
+        self._sharded_draft_params = self._sharded_draft_variables = None
+        self._jdraft_step = self._jdraft_prefill = None
+        self._jdraft_zero = self._jverify = None
+        self._jfixpos = self._jdraft_fixpos = None
+        if speculate and int(speculate) > 0:
+            reason = None
+            if not (self._graph and self._chunk_dense and attn_keys):
+                reason = ("the model is not a transformer "
+                          "ComputationGraph with an attention KV cache "
+                          "to verify against")
+            elif not self.prefill_buckets:
+                reason = ("chunked prefill is disabled (prefill_chunk "
+                          "<= 1) and the draft needs its chunk programs")
+            draft = draft_net
+            kk = int(draft_blocks) if draft_blocks else \
+                max(1, len(attn_keys) // 2)
+            if reason is None and draft is None:
+                # paged engines decode past the conf's max_cache_len
+                # (capacity is pool bytes), but the draft's private
+                # cache is DENSE per-slot stripes — sizing it to the
+                # whole pool depth would cost n_slots x pool-depth
+                # rows per draft layer, unbounded by any budget knob.
+                # Cap it at the model's own max_cache_len: sequences
+                # past that depth simply decode plain (_spec_ready's
+                # draft-headroom check), they never break
+                draft_depth = None
+                if self.paged:
+                    draft_depth = min(self._cache_cap,
+                                      self._min_cache_len() or
+                                      self._cache_cap)
+                try:
+                    draft = build_shallow_draft(
+                        net, kk, max_cache_len=draft_depth)
+                except ValueError as e:
+                    reason = f"no self-speculative draft ({e})"
+            if reason is not None:
+                warnings.warn(
+                    f"speculate={speculate} requested but speculative "
+                    f"decoding is DISABLED: {reason}; pass draft_net= "
+                    "for models the shallow-exit surgery cannot cut",
+                    RuntimeWarning, stacklevel=2)
+            else:
+                self.speculate = int(speculate)
+                self.draft = draft
+                self.draft_blocks = kk if draft_net is None else 0
+                caps = [int(getattr(impl.conf, "max_cache_len", 1024))
+                        for _, impl in self._draft_impl_items()
+                        if type(impl).__name__ == "SelfAttentionLayerImpl"]
+                self._draft_cap = min(caps) if caps else None
+                # the draft's private KV cache: contiguous per-slot
+                # stripes even under a paged main cache (K layers only,
+                # and its rows are always re-derivable — no pool
+                # metadata, no sharing, no preemption bookkeeping)
+                self._draft_states = self._init_draft_states()
+                if self.mesh is not None:
+                    # the draft joins the mesh: same Megatron specs (its
+                    # conf is a prefix of the target's), same head-axis
+                    # cache sharding — and the same collective audit
+                    # (sharding.draft_program_hlo)
+                    self._sharded_draft_params, \
+                        self._sharded_draft_variables = \
+                        shard_decode_params(draft, self.mesh)
+                    self._draft_states = jax.device_put(
+                        self._draft_states,
+                        state_shardings(self._draft_states, self.mesh))
+                self._jdraft_step = jax.jit(self._draft_step_fn)
+                self._jdraft_prefill = jax.jit(self._draft_prefill_fn)  # graftlint: disable=JG004
+                self._jdraft_zero = jax.jit(self._zero_fn)
+                self._jverify = jax.jit(
+                    self._verify_paged_fn if self.paged
+                    else self._verify_fn)
+                self._jfixpos = jax.jit(self._fixpos_fn)
+                self._jdraft_fixpos = jax.jit(self._fixpos_fn)
         self._prefill_next = 0  # round-robin over prefilling slots
         self._emitted_this_iter = 0  # scheduler-thread-only tally
         m = self.metrics
@@ -719,6 +891,14 @@ class DecodeScheduler:
             hi=float(max(self.prefill_buckets or [1])) + 1, per_decade=12)
         if self.paged:
             self._m_preempted = m.counter("decode_preempted_total")
+            # best-of-n COW forks: candidates that attached to a fork
+            # group's published prompt blocks (zero-copy remaps)
+            self._m_forks = m.counter("decode_forks_total")
+        if self.speculate:
+            self._m_spec_proposed = m.counter("spec_tokens_proposed_total")
+            self._m_spec_accepted = m.counter("spec_tokens_accepted_total")
+            m.ratio("spec_acceptance_rate", self._m_spec_accepted,
+                    self._m_spec_proposed)
         if self.pool is not None:
             self._m_prefix_lookups = m.counter("prefix_cache_lookups_total")
             self._m_prefix_hits = m.counter("prefix_cache_hits_total")
@@ -824,10 +1004,11 @@ class DecodeScheduler:
         for key, st in new_states.items():
             old = old_states[key]
             if isinstance(st, dict):
-                # pages are exempt like k/v: a masked slot's paged write
-                # was redirected to the scratch page in-program (wmask),
-                # so there is nothing to roll back
-                out[key] = {k: (v if k in ("k", "v", "k_pages", "v_pages")
+                # pages (and their int8 dequant scales) are exempt like
+                # k/v: a masked slot's paged write was redirected to the
+                # scratch page in-program (wmask), so there is nothing
+                # to roll back
+                out[key] = {k: (v if k in ("k", "v") + PAGE_KEYS
                                 else sel(v, old[k]))
                             for k, v in st.items()}
             else:
@@ -887,7 +1068,7 @@ class DecodeScheduler:
         out = {}
         for key, st in states.items():
             if isinstance(st, dict) and "k_pages" in st:
-                out[key] = {k: (v if k in ("k_pages", "v_pages") else f(v))
+                out[key] = {k: (v if k in PAGE_KEYS else f(v))
                             for k, v in st.items()}
             else:
                 out[key] = jax.tree_util.tree_map(f, st)
@@ -906,7 +1087,7 @@ class DecodeScheduler:
         out = {}
         for key, st in states.items():
             if isinstance(st, dict) and "k_pages" in st:
-                out[key] = {k: (sub[key][k] if k in ("k_pages", "v_pages")
+                out[key] = {k: (sub[key][k] if k in PAGE_KEYS
                                 else f(v, sub[key][k]))
                             for k, v in st.items()}
             else:
@@ -1013,6 +1194,125 @@ class DecodeScheduler:
                 fixed[key] = st
         return probs, self._scatter_slot(states, fixed, s)
 
+    # -- speculative decoding programs -------------------------------------
+    def _draft_impl_items(self):
+        impls = self.draft._impls
+        return impls.items() if isinstance(impls, dict) else enumerate(impls)
+
+    @property
+    def _draft_params(self):
+        """Draft dispatch params: sharded copies under a mesh, else the
+        LIVE arrays by name — the shallow-exit draft shares the target's
+        weights, so a rebound-after-fit() net keeps drafting fresh."""
+        if self._sharded_draft_params is not None:
+            return self._sharded_draft_params
+        return {name: self.net.params.get(name, p)
+                for name, p in self.draft.params.items()} \
+            if self.draft_blocks else self.draft.params
+
+    @property
+    def _draft_variables(self):
+        return self._sharded_draft_variables \
+            if self._sharded_draft_variables is not None \
+            else self.draft.variables
+
+    def _init_draft_states(self) -> Dict[Any, Any]:
+        """The draft net's private per-layer state (its own contiguous
+        KV cache over the first K blocks), per-slot pos vectors like the
+        main cache."""
+        states = _materialize_rnn_states(self._draft_impl_items(), {},
+                                         self.n_slots, self._dtype)
+        for key, st in states.items():
+            if isinstance(st, dict) and "pos" in st and st["pos"].ndim == 0:
+                states[key] = {**st,
+                               "pos": jnp.zeros((self.n_slots,), jnp.int32)}
+        return states
+
+    def _draft_forward(self, params, variables, x, states):
+        """One forward through the DRAFT graph (shallow exit or explicit
+        draft net) with explicit states — the draft-side `_forward`."""
+        acts, _, new_states = self.draft._forward_impl(
+            params, variables, [x], train=False, rng=None, states=states)
+        return acts[self.draft.conf.network_outputs[0]], new_states
+
+    def _draft_step_fn(self, params, variables, ids, live, states):
+        """One single-token draft forward for all slots (the lockstep
+        proposal round): `_step_fn` against the draft graph and its
+        contiguous cache. One XLA program, mesh sizes included."""
+        x = jax.nn.one_hot(ids, self.vocab_size, dtype=self._dtype)[:, None]
+        out, new_states = self._draft_forward(params, variables, x, states)
+        return out[:, -1, :], self._freeze_states(new_states, states, live)
+
+    def _draft_prefill_fn(self, params, variables, slot, ids, n_real,
+                          states):
+        """Chunked prefill into the draft cache: the dense path of
+        `_prefill_fn` against the draft graph, one program per pow2
+        chunk bucket. Runs piggybacked on every main prefill chunk (the
+        draft must ingest the prompt to propose from it) and as the
+        catch-up program after prefix restores/resumes jump the MAIN
+        cache past tokens the draft never saw."""
+        slot = slot[0]
+        n_real = n_real[0]
+        sub = self._slice_slot(states, slot)
+        x = jax.nn.one_hot(ids, self.vocab_size, dtype=self._dtype)[None]
+        out, new_sub = self._draft_forward(params, variables, x, sub)
+        probs = jax.lax.dynamic_index_in_dim(out, n_real - 1, axis=1,
+                                             keepdims=False)[0]
+        fixed = {}
+        for key, st in new_sub.items():
+            if isinstance(st, dict) and "pos" in st:
+                pos = sub[key]["pos"] + n_real
+                if "k" in st:
+                    cap = st["k"].shape[1]
+                    pos = jnp.where(st["pos"] > cap, st["pos"], pos)
+                fixed[key] = {**st, "pos": pos}
+            else:
+                fixed[key] = st
+        return probs, self._scatter_slot(states, fixed, slot)
+
+    def _verify_fn(self, params, variables, ids, live, states):
+        """THE multi-token verify program: one target-model forward over
+        ``ids`` [n_slots, gamma+1] chains, per-slot positions, retaining
+        EVERY position's next-token distribution ([n_slots, gamma+1,
+        vocab]) — the chunked-prefill machinery pointed at decode.
+        Chain rows are written into the cache at [pos, pos+gamma+1);
+        rejected rows are rolled back host-side by `_fixpos_fn` (they
+        sit beyond the corrected pos, causally invisible and overwritten
+        by the next real write — the same invariant slot reuse rests
+        on). Masked slots are frozen exactly like the decode step."""
+        x = jax.nn.one_hot(ids, self.vocab_size, dtype=self._dtype)
+        out, new_states = self._forward(params, variables, x, states)
+        return out, self._freeze_states(new_states, states, live)
+
+    def _verify_paged_fn(self, params, variables, ids, live, table,
+                         states):
+        """Paged verify: `_verify_fn` writing through the block table.
+        ``live`` doubles as the write mask (broadcast over the chain
+        lanes) — a masked slot's rows redirect to the scratch page. The
+        scheduler pre-allocates blocks covering pos+gamma+1 and
+        truncates the table back after acceptance."""
+        x = jax.nn.one_hot(ids, self.vocab_size, dtype=self._dtype)
+        sts = self._inject_paged(states, table, live[:, None])
+        out, new_states = self._forward(params, variables, x, sts)
+        return out, self._freeze_states(new_states, states, live)
+
+    def _fixpos_fn(self, states, posv, mask):
+        """Post-verify rollback: set every attention layer's cache
+        position to ``posv`` [n_slots] where ``mask`` is True (the slots
+        that speculated this iteration), freeze the rest. The verify
+        program advanced pos by the full padded chain; acceptance is
+        decided host-side, so the correction is a separate (tiny, single)
+        program — the rejected tail rows become causally invisible the
+        moment pos steps back over them."""
+        out = {}
+        for key, st in states.items():
+            if isinstance(st, dict) and "pos" in st \
+                    and ("k" in st or "k_pages" in st):
+                out[key] = {**st, "pos": jnp.where(mask, posv, st["pos"])}
+            else:
+                out[key] = st
+        return out
+
     def _pick_chunk(self, seq: _ActiveSeq) -> Tuple[int, int]:
         """(bucket, n_real) for this sequence's next prefill chunk, or
         (0, 0) when no bucket fits the KV-cache headroom (the tail then
@@ -1059,8 +1359,7 @@ class DecodeScheduler:
         out = {}
         for key, st in states.items():
             if isinstance(st, dict) and "k_pages" in st:
-                out[key] = {k: (v if k in ("k_pages", "v_pages")
-                                else zero_row(v))
+                out[key] = {k: (v if k in PAGE_KEYS else zero_row(v))
                             for k, v in st.items()}
             else:
                 out[key] = jax.tree_util.tree_map(zero_row, st)
@@ -1091,10 +1390,10 @@ class DecodeScheduler:
         out = {}
         for key, st in states.items():
             if isinstance(st, dict) and "k_pages" in st:
+                # scale pages (int8 KV mode) duplicate with their values
                 out[key] = {
-                    **st,
-                    "k_pages": st["k_pages"].at[d].set(st["k_pages"][s]),
-                    "v_pages": st["v_pages"].at[d].set(st["v_pages"][s]),
+                    k: (v.at[d].set(v[s]) if k in PAGE_KEYS else v)
+                    for k, v in st.items()
                 }
             else:
                 out[key] = st
@@ -1108,6 +1407,11 @@ class DecodeScheduler:
         # is idle-by-construction (no slot admitted yet), and stop()'s
         # sweep runs after the join. CC005 cannot see that protocol.
         self._states = self._jzero(self._states, self._dev_index(slot))  # graftlint: disable=CC005
+        if self.speculate:
+            # the draft cache is slot-aligned with the main cache: a
+            # reused slot starts the draft at row 0 too
+            self._draft_states = self._jdraft_zero(  # graftlint: disable=CC005
+                self._draft_states, self._dev_index(slot))
 
     # -- prefix KV reuse (kvpool.py) ---------------------------------------
     def _try_restore(self, slot: int, seq: _ActiveSeq) -> None:
@@ -1134,6 +1438,7 @@ class DecodeScheduler:
             self._states, self._dev_index(slot), self._dev_array(idx),
             self._dev_index(n_blk), self.pool.storage)
         seq.fed = n_blk * B
+        seq.written = seq.fed  # host pos mirror (speculation's fixpos)
         self._m_prefix_hits.inc()
         self._m_prefix_hit_tokens.inc(seq.fed)
 
@@ -1310,6 +1615,7 @@ class DecodeScheduler:
         seq.folded = len(h.tokens)
         seq.fed = 0
         seq.written = 0
+        seq.draft_fed = 0  # the draft cache re-ingests on resume too
         seq.phase = "preempted"
         seq.resumed = True
         # single-writer: _slots is mutated only on this scheduler thread
@@ -1374,6 +1680,20 @@ class DecodeScheduler:
         seq.written = fed
         self._m_prefix_hits.inc()
         self._m_prefix_hit_tokens.inc(fed)
+        if seq.fork is not None \
+                and seq.fork.primary_handle is not seq.handle \
+                and not seq.resumed:
+            # a best-of-n FOLLOWER attached to its group's published
+            # prompt blocks: the COW fork proper (n candidates, one
+            # prompt's worth of KV). The primary's own trie hit and
+            # preempt-resume re-restores are ordinary prefix hits, not
+            # forks — counting them would inflate the metric past n-1
+            self._m_forks.inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "fork", track=self._slot_tracks[slot],
+                    args={"request": seq.handle.request_id,
+                          "role": "attach", "blocks": n_blk})
 
     def _publish_paged(self, slot: int, seq: _ActiveSeq) -> frozenset:
         """Zero-copy publish: the finished sequence's full prompt blocks
@@ -1395,14 +1715,20 @@ class DecodeScheduler:
                top_p: Optional[float] = None, seed: int = 0,
                eos_id: Optional[int] = None,
                request_id: Optional[str] = None, priority: int = 0,
+               fork: Optional[ForkGroup] = None,
                _handle: Optional[DecodeHandle] = None,
                _front: bool = False) -> DecodeHandle:
         """``priority``: degradation-ladder shedding order (higher
-        survives longer; default 0). ``_handle``/``_front``: the
-        supervisor's crash-recovery resubmission path — reuse the
-        ORIGINAL (reset) handle so the caller blocked in ``result()``
-        never notices the restart, and front-queue recovered work so it
-        does not wait behind requests submitted after the crash."""
+        survives longer; default 0). ``fork``: best-of-n candidate
+        group (`speculative.ForkGroup`, see :meth:`generate_many`) —
+        the first submission becomes the primary; follower candidates
+        stay queued until the primary's prefill publishes the prompt's
+        paged blocks, then restore them copy-on-write. ``_handle``/
+        ``_front``: the supervisor's crash-recovery resubmission path —
+        reuse the ORIGINAL (reset) handle so the caller blocked in
+        ``result()`` never notices the restart, and front-queue
+        recovered work so it does not wait behind requests submitted
+        after the crash."""
         rid = _handle.request_id if _handle is not None \
             else (request_id or new_request_id())
         if not len(prompt_ids):
@@ -1457,6 +1783,9 @@ class DecodeScheduler:
             priority=priority)
         seq = _ActiveSeq(handle, prompt_ids, temperature, top_k, top_p,
                          seed, eos_id)
+        if fork is not None:
+            fork.bind_primary(handle)
+            seq.fork = fork
         with self._cond:
             if not self._running:
                 raise RuntimeError("scheduler is not running (call start())")
@@ -1505,6 +1834,28 @@ class DecodeScheduler:
         """Blocking submit — drop-in for `generate_transformer` greedy."""
         return self.generate_handle(prompt_ids, max_new_tokens,
                                     timeout=timeout, **kw).tokens
+
+    def generate_many(self, prompt_ids: Sequence[int], n: int,
+                      max_new_tokens: int,
+                      timeout: Optional[float] = 120.0, *, seed: int = 0,
+                      **kw) -> List[DecodeHandle]:
+        """Best-of-n over ONE prompt: ``n`` candidates submitted as a
+        copy-on-write fork group (`speculative.submit_fork_group` — the
+        shared submission protocol: seed+i per candidate, partial-
+        submit failures cancel the already-submitted, a timeout cancels
+        all unfinished). In paged mode the first candidate (the
+        primary) prefills the prompt once and publishes its blocks the
+        moment its prefill completes; follower candidates restore them
+        as zero-copy block-table remaps and copy-on-write only the tail
+        block they write — n candidates cost ~one prompt's worth of KV
+        instead of n (`decode_forks_total` counts the attaches).
+        Candidate 0 reproduces the n=1 output for the same seed
+        exactly."""
+        from .speculative import await_fork_group, submit_fork_group
+        handles = submit_fork_group(self.submit, prompt_ids, n,
+                                    max_new_tokens, seed=seed, **kw)
+        await_fork_group(handles, timeout)
+        return handles
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "DecodeScheduler":
@@ -1671,13 +2022,25 @@ class DecodeScheduler:
             for i in range(self.n_slots):
                 if blocked or self._slots[i] is not None:
                     continue
-                while self._queue:
-                    seq = self._queue[0]
+                qi = 0
+                while qi < len(self._queue):
+                    seq = self._queue[qi]
                     if seq.handle.cancelled():  # gave up while queued
-                        self._queue.pop(0)
+                        self._queue.pop(qi)
                         self._m_cancelled.inc()
                         seq.handle._finish()
                         self._trace_done("cancel", seq)
+                        continue
+                    if (self.paged and seq.fork is not None
+                            and seq.fork.waiting(seq.handle)):
+                        # best-of-n FOLLOWER: stay queued until the
+                        # primary's prefill publishes the prompt blocks
+                        # this candidate exists to share — admitting it
+                        # now would cold-prefill its own copy and defeat
+                        # the fork. Bounded wait (one prefill), not
+                        # starvation: the gate opens the moment the
+                        # primary publishes, finishes, or dies.
+                        qi += 1
                         continue
                     if not self._pool_can_admit(seq, reclaim_memo,
                                                 pending_blocks):
@@ -1686,7 +2049,7 @@ class DecodeScheduler:
                         # preempted sequence the gate exists to protect
                         blocked = True
                         break
-                    self._queue.pop(0)
+                    self._queue.pop(qi)
                     self._slots[i] = seq
                     if self.paged:
                         pending_blocks += self._blocks_for(len(seq.prompt))
@@ -1739,14 +2102,50 @@ class DecodeScheduler:
         yields the first output token). Token-count metrics are NOT
         updated here — the loop flushes one batched `inc(n)` per
         iteration instead of taking the counter lock once per token."""
+        tok = sample_logits(probs_row, seq.temperature, seq.top_k,
+                            seq.rng, seq.top_p)
+        self._emit(slot, seq, tok)
+
+    def _fork_publish(self, slot: int, seq: _ActiveSeq) -> None:
+        """Best-of-n early publish: the fork group's PRIMARY just
+        finished prefill — run the SAME `_publish_paged` ownership
+        transfer finish-time publish uses, just earlier, so queued
+        sibling candidates restore the prompt blocks as zero-copy
+        block-table remaps instead of each re-prefilling. The adopted
+        blocks flip to shared in the slot's own bookkeeping (its next
+        write into one — there is none before the decode tail — would
+        COW), and the slot takes a trie pin so eviction cannot free
+        rows it still reads."""
+        group = seq.fork
+        adopted = self._publish_paged(slot, seq)
+        if adopted:
+            for j, bid in enumerate(seq.block_ids):
+                if bid in adopted:
+                    seq.shared[j] = True
+            self._release_pool(seq)
+            n_full = len(seq.prompt) // self.pool.block
+            _, _, node = self.pool.match(seq.prompt, n_full)
+            seq.pool_node = node
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "fork", track=self._slot_tracks[slot],
+                    args={"request": seq.handle.request_id,
+                          "role": "publish", "blocks": len(adopted),
+                          "candidates": group.n})
+        group.published = True
+
+    def _emit(self, slot: int, seq: _ActiveSeq, tok: int) -> None:
+        """Append one ALREADY-SAMPLED output token to the handle;
+        finish + evict on max_new_tokens or EOS. The single emission
+        path shared by plain decode (`_consume` samples then emits) and
+        the speculative acceptance loop (which sampled while walking
+        the verify distributions)."""
         if self._fenced:
             # a fenced thread woke mid-iteration: this handle may
             # already be requeued on the replacement engine — appending
             # a token (or finishing) here would corrupt/duplicate it
             raise _EngineFenced
         h = seq.handle
-        tok = sample_logits(probs_row, seq.temperature, seq.top_k,
-                            seq.rng, seq.top_p)
         h.tokens.append(tok)
         self._emitted_this_iter += 1
         now = time.monotonic()
@@ -1763,6 +2162,10 @@ class DecodeScheduler:
                             args={"steps": seq.steps})
             self.tracer.begin("decode", req=h.request_id)
             seq.phase = "decode"
+            if (self.paged and seq.fork is not None
+                    and seq.fork.primary_handle is h
+                    and not seq.fork.published):
+                self._fork_publish(slot, seq)
         if (len(h.tokens) >= h.max_new_tokens
                 or (seq.eos_id is not None and tok == seq.eos_id)):
             if self.pool is not None:
@@ -1826,6 +2229,21 @@ class DecodeScheduler:
                     self._params, self._variables,
                     self._dev_index(i), self._dev_array(ids),
                     self._dev_index(n_real), self._states)
+                seq.written += n_real  # host pos mirror (spec fixpos)
+            if self.speculate and seq.draft_fed == seq.fed \
+                    and self._draft_cap is not None \
+                    and seq.draft_fed + bucket <= self._draft_cap:
+                # piggyback: the DRAFT ingests the same chunk (it must
+                # hold the prompt to propose continuations of it) — one
+                # extra shallow dispatch per chunk, the speculation tax
+                # on TTFT. A restore-jumped sequence is out of sync
+                # (draft_fed < fed) and catches up via
+                # _run_draft_catchup instead.
+                _, self._draft_states = self._jdraft_prefill(
+                    self._draft_params, self._draft_variables,
+                    self._dev_index(i), self._dev_array(ids),
+                    self._dev_index(n_real), self._draft_states)
+                seq.draft_fed += n_real
             seq.fed += n_real
             seq.steps += 1
             self._m_prefill_tokens.inc(n_real)
@@ -1836,6 +2254,203 @@ class DecodeScheduler:
             self._prefill_next = (i + 1) % self.n_slots
             return i
         return None
+
+    # -- speculative decoding: draft, verify, accept, roll back ------------
+    def _spec_ready(self, seq: _ActiveSeq) -> bool:
+        """Can this decode-ready slot speculate THIS iteration? Needs
+        the draft within lockstep range (lag 1 after a plain accept, 2
+        after a fully-accepted round — anything more is mid-catch-up),
+        gamma+1 rows of cache headroom on both nets, and at least 2
+        tokens still wanted (the last token is cheapest decoded plain)."""
+        G = self.speculate
+        h = seq.handle
+        lag = seq.known_tokens() - seq.draft_fed
+        # lag > G would make every lockstep round a catch-up round and
+        # send ZERO proposals to the verify — speculate=1's post-full-
+        # accept lag-2 state would pay draft+verify+fixpos per single
+        # token forever; decoding plain instead grows lag past 2 and
+        # _run_draft_catchup resyncs the draft for the next real round
+        if not 1 <= lag <= min(2, G):
+            return False
+        if h.max_new_tokens - len(h.tokens) < 2:
+            return False
+        if self._cache_cap is not None and \
+                seq.written + G + 1 > self._cache_cap:
+            return False
+        if self._draft_cap is not None and \
+                seq.draft_fed + G > self._draft_cap:
+            return False
+        return True
+
+    def _run_draft_catchup(self) -> Optional[int]:
+        """At most one draft catch-up chunk per iteration: a decode-
+        phase sequence whose MAIN cache jumped past tokens the draft
+        never ingested (prefix restore, preempt-resume) re-feeds the
+        gap through the draft's chunk-prefill program — the draft costs
+        ~K/N of a forward, so a restored prefix still keeps most of its
+        TTFT win. The slot decodes plain until lag re-enters lockstep
+        range."""
+        if not self.speculate:
+            return None
+        for i in range(self.n_slots):
+            seq = self._slots[i]
+            if seq is None or not seq.sampling:
+                continue
+            lag = seq.known_tokens() - seq.draft_fed
+            if lag <= 2:
+                continue
+            # target full_len - 1: the LAST token is the lockstep
+            # round's feed (its draft output is the first proposal)
+            n_real = min(lag - 1, self.prefill_chunk)
+            bucket = bucket_for(n_real, self.prefill_buckets)
+            if self._draft_cap is not None and \
+                    seq.draft_fed + bucket > self._draft_cap:
+                fitting = [b for b in self.prefill_buckets
+                           if seq.draft_fed + b <= self._draft_cap]
+                if not fitting:
+                    continue  # no draft headroom: stays plain decode
+                bucket = fitting[-1]
+                n_real = min(n_real, bucket)
+            full = seq.full_context()
+            ids = np.zeros((bucket,), np.int32)
+            ids[:n_real] = full[seq.draft_fed:seq.draft_fed + n_real]
+            _, self._draft_states = self._jdraft_prefill(
+                self._draft_params, self._draft_variables,
+                self._dev_index(i), self._dev_array(ids),
+                self._dev_index(n_real), self._draft_states)
+            seq.draft_fed += n_real
+            return i
+        return None
+
+    def _truncate_blocks(self, slot: int, seq: _ActiveSeq) -> int:
+        """Paged rollback: pop the slot's table entries that now sit
+        wholly beyond the accepted frontier (verify pre-allocated blocks
+        through pos+gamma+1; acceptance may have stopped short) and
+        return the owned pages to the pool. Shared (trie-owned) blocks
+        never extend past the write frontier, but the guard keeps a
+        refcount leak structurally impossible. Returns blocks freed."""
+        need = self._blocks_for(seq.written)
+        freed = 0
+        while len(seq.block_ids) > need:
+            bid = seq.block_ids.pop()
+            sh = seq.shared.pop()
+            self._table[slot, len(seq.block_ids)] = SCRATCH_BLOCK
+            if not sh:
+                self.pool.free_block(bid)
+            freed += 1
+        return freed
+
+    def _run_speculation(self, spec: List[Tuple[int, _ActiveSeq]]) -> None:
+        """The speculative iteration for every eligible slot at once:
+
+        1. DRAFT — gamma lockstep rounds of the cheap draft step
+           (shallow exit / draft net), each round feeding the previous
+           round's greedy output; round r < lag feeds catch-up tokens
+           the draft hasn't ingested (lag 2 follows a fully-accepted
+           round, where the bonus token was never drafted).
+        2. VERIFY — ONE multi-token target forward over all chains
+           (`[last_token, d_1..d_g]`, padded to gamma+1), every
+           position's distribution retained.
+        3. ACCEPT — `speculative.accept_tokens` samples each position
+           from the TARGET distribution with the sequence's own RNG and
+           keeps the longest draft-confirmed prefix (+1 bonus): output
+           is token-identical to solo decode by construction.
+        4. ROLL BACK — one fixpos program per net steps pos back over
+           the rejected tail; paged mode also truncates the block table
+           and returns the freed pages.
+        """
+        G = self.speculate
+        tr = self.tracer
+        dp, dv = self._draft_params, self._draft_variables
+        info = []
+        for i, seq in spec:
+            known = seq.known_tokens()
+            lag = known - seq.draft_fed
+            # the lockstep only feeds the trailing lag (<= 2) tokens —
+            # an O(lag) tail, never an O(context) copy per iteration
+            info.append((i, seq, known, lag, seq.tail_context(lag), []))
+        live = np.zeros((self.n_slots,), bool)
+        for i, _seq, _k, _l, _t, _p in info:
+            live[i] = True
+        ldev = self._dev_array(live)
+        for r in range(G):
+            ids = np.zeros((self.n_slots,), np.int32)
+            for i, seq, known, lag, tail, props in info:
+                ids[i] = tail[r] if r < lag else props[r - lag]
+            dprobs, self._draft_states = self._jdraft_step(
+                dp, dv, self._dev_array(ids), ldev, self._draft_states)
+            rows = host_read(dprobs)
+            for i, seq, known, lag, tail, props in info:
+                if r >= lag - 1:  # catch-up rounds' outputs are known
+                    # rows is host numpy (the host_read above IS the
+                    # sanctioned boundary); this int() syncs nothing
+                    props.append(int(rows[i].argmax()))  # graftlint: disable=JG006
+        # seam BEFORE any span opens (the decode/prefill seam ordering:
+        # an injected crash must not strand unclosed B-events)
+        failpoints.fire("dispatch.verify")
+        ids2 = np.zeros((self.n_slots, G + 1), np.int32)
+        for i, seq, known, lag, tail, props in info:
+            chain = [tail[-1]] + props
+            chain += [chain[-1]] * (G + 1 - len(chain))  # pad lanes
+            ids2[i] = chain
+            if tr.enabled:
+                tr.instant("draft", track=self._slot_tracks[i],
+                           args={"request": seq.handle.request_id,
+                                 "proposed": len(props)})
+                tr.begin("verify", req=seq.handle.request_id,
+                         args={"slot": i, "proposed": len(props)})
+        if self.paged:
+            table = self._table_for(max(s.written + G + 1
+                                        for _, s, _k, _l, _t, _p in info))
+            vprobs, self._states = self._jverify(
+                self._params, self._variables, self._dev_array(ids2),
+                ldev, self._dev_array(table), self._states)
+        else:
+            vprobs, self._states = self._jverify(
+                self._params, self._variables, self._dev_array(ids2),
+                ldev, self._states)
+        rows2 = host_read(vprobs)
+        posv = np.zeros((self.n_slots,), np.int32)
+        dposv = np.zeros((self.n_slots,), np.int32)
+        mask = np.zeros((self.n_slots,), bool)
+        proposed = accepted = 0
+        for i, seq, known, lag, tail, props in info:
+            h = seq.handle
+            remaining = h.max_new_tokens - len(h.tokens)
+            emitted, matched = accept_tokens(
+                rows2[i], props, seq.temperature, seq.top_k, seq.top_p,
+                seq.rng, remaining, seq.eos_id)
+            proposed += len(props)
+            accepted += matched
+            seq.steps += 1
+            seq.written += len(emitted)
+            seq.draft_fed = known + min(G - lag, matched)
+            for tok in emitted:
+                self._emit(i, seq, tok)
+            freed = 0
+            if self.paged and self._slots[i] is seq:
+                freed = self._truncate_blocks(i, seq)
+            mask[i] = True
+            posv[i] = seq.written
+            dposv[i] = seq.draft_fed
+            if tr.enabled:
+                tr.end("verify", req=h.request_id,
+                       args={"accepted": len(emitted),
+                             "matched": matched})
+                if len(emitted) < len(props) + 1:
+                    tr.instant(
+                        "rollback", track=self._slot_tracks[i],
+                        args={"request": h.request_id,
+                              "rejected": len(props) + 1 - len(emitted),
+                              "blocks_freed": freed})
+        mdev = self._dev_array(mask)
+        self._states = self._jfixpos(self._states,
+                                     self._dev_array(posv), mdev)
+        self._draft_states = self._jdraft_fixpos(
+            self._draft_states, self._dev_array(dposv), mdev)
+        self._m_spec_proposed.inc(proposed)
+        if accepted:
+            self._m_spec_accepted.inc(accepted)
 
     def _step_once(self) -> bool:
         """One scheduler iteration (admission + at most one prefill chunk
@@ -1861,10 +2476,16 @@ class DecodeScheduler:
         t0 = time.monotonic()
         self._emitted_this_iter = 0
         chunked = self._run_prefill_chunk()
+        self._run_draft_catchup()
         # decode step: every decode-ready slot, plus token-by-token
         # prefill for slots chunked prefill cannot serve (disabled, or
-        # no bucket fits the remaining cache headroom)
+        # no bucket fits the remaining cache headroom). With speculation
+        # armed, eligible slots ride the draft+verify path (`spec`)
+        # instead of the single-token program; the rest — mid-catch-up,
+        # out of gamma+1 headroom, one token from done — decode plain.
         fed: List[Tuple[int, _ActiveSeq]] = []
+        spec: List[Tuple[int, _ActiveSeq]] = []
+        G = self.speculate
         # oldest-first (same t_submit key as _pick_victim): a
         # pool-pressure preemption always victimizes the LATEST-submitted
         # slot, which is processed last here — so an already-vetted
@@ -1878,11 +2499,13 @@ class DecodeScheduler:
             if not seq.sampling and self.prefill_buckets \
                     and self._pick_chunk(seq)[1]:
                 continue  # mid-prefill: waits for its chunk turn
+            want = G + 1 if G and seq.sampling and self._spec_ready(seq) \
+                else 1
             if self.paged:
-                if not self._ensure_blocks(i, seq, seq.written + 1) \
+                if not self._ensure_blocks(i, seq, seq.written + want) \
                         or not self._ensure_writable(i, seq, seq.written):
                     continue  # seq itself was preempted for blocks
-            fed.append((i, seq))
+            (spec if want > 1 else fed).append((i, seq))
         if fed:
             ids = np.zeros((self.n_slots,), np.int32)
             live = np.zeros((self.n_slots,), bool)
@@ -1916,6 +2539,8 @@ class DecodeScheduler:
                     continue  # still prefilling; output not sampled yet
                 self._consume(i, seq, probs[i])
             self.tracer.end("decode_step", track=self._sched_track)
+        if spec:
+            self._run_speculation(spec)
         if self._emitted_this_iter:
             self._m_tokens.inc(self._emitted_this_iter)
         self._m_occupancy.record(len(active))
@@ -2132,6 +2757,33 @@ class DecodeScheduler:
                         self._dev_array(np.zeros((b,), np.int32)),
                         self.pool.storage)
         self._jzero(self._states, slot0)
+        if self.speculate:
+            # speculation's program family: the multi-token verify (per
+            # table bucket in paged mode, like decode), the draft's
+            # step/prefill/zero, and both fixpos rollback programs —
+            # a rebuilt engine must not pay these compiles under traffic
+            ids2 = self._dev_array(
+                np.zeros((self.n_slots, self.speculate + 1), np.int32))
+            if self.paged:
+                for nb in self.table_buckets:
+                    table = self._dev_array(np.full(
+                        (self.n_slots, nb), SCRATCH_BLOCK, np.int32))
+                    self._jverify(params, variables, ids2, live, table,
+                                  self._states)
+            else:
+                self._jverify(params, variables, ids2, live, self._states)
+            dp, dv = self._draft_params, self._draft_variables
+            self._jdraft_step(dp, dv, ids, live, self._draft_states)
+            for b in self.prefill_buckets:
+                self._jdraft_prefill(
+                    dp, dv, slot0,
+                    self._dev_array(np.zeros((b,), np.int32)), one,
+                    self._draft_states)
+            self._jdraft_zero(self._draft_states, slot0)
+            posv = self._dev_array(np.zeros((self.n_slots,), np.int32))
+            nomask = self._dev_array(np.zeros((self.n_slots,), bool))
+            self._jfixpos(self._states, posv, nomask)
+            self._jdraft_fixpos(self._draft_states, posv, nomask)
 
     def shed_queued(self, target_depth: int) -> int:
         """Degradation ladder level >= 1: drop queued (never admitted)

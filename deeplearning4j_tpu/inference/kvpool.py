@@ -87,6 +87,12 @@ from .trace import FlightRecorder
 # need a mask — real blocks are numbered from 1
 SCRATCH_BLOCK = 0
 
+# every pool-wide page-array key a paged attention state may carry: K/V
+# pages plus (int8 KV mode) their per-row dequantization scales. The
+# single source of truth for "this leaf is SHARED pool storage, not a
+# per-slot row" across the engine's slice/scatter/zero/freeze/COW paths.
+PAGE_KEYS = ("k_pages", "v_pages", "k_scales", "v_scales")
+
 
 class _Node:
     """One full block of a cached prefix: ``key`` is the block's token
@@ -134,12 +140,21 @@ class KVPool:
 
     def __init__(self, attn_states: Dict, *, block: int, budget_bytes: int,
                  paged: bool = False, shard_factor: int = 1,
+                 cache_dtype: Optional[str] = None,
                  metrics: Optional[MetricsRegistry] = None,
                  tracer: Optional[FlightRecorder] = None):
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
+        if cache_dtype not in (None, "int8"):
+            raise ValueError(f"cache_dtype must be None or 'int8', got "
+                             f"{cache_dtype!r}")
+        if cache_dtype and not paged:
+            raise ValueError("cache_dtype='int8' requires paged mode "
+                             "(the contiguous side pool stores the "
+                             "model's own K/V dtype)")
         self.block = int(block)
         self.paged = bool(paged)
+        self.cache_dtype = cache_dtype
         self.shard_factor = max(1, int(shard_factor))
         # flight recorder (trace.py): eviction/publish instants on the
         # `kvpool` track; None (standalone pool) records nothing
@@ -151,8 +166,17 @@ class KVPool:
             row_shape = tuple(st["k"].shape[2:])  # (Hkv, Dh)
             dtype = st["k"].dtype
             shapes[key] = (row_shape, dtype)
-            per_block += 2 * self.block * int(jnp.dtype(dtype).itemsize) \
-                * int(math.prod(row_shape))
+            if cache_dtype == "int8":
+                # int8 KV pages + one f32 dequant scale per (position,
+                # head) row: Hkv*Dh bytes of values + Hkv*4 of scales
+                # per position per k-or-v — under half the f32 cost for
+                # any Dh >= 8, so the same budget holds >= 2x the blocks
+                row_bytes = int(math.prod(row_shape)) \
+                    + int(row_shape[0]) * 4
+            else:
+                row_bytes = int(jnp.dtype(dtype).itemsize) \
+                    * int(math.prod(row_shape))
+            per_block += 2 * self.block * row_bytes
         # per-DEVICE block cost: the head axis splits evenly over the
         # mesh (the engine refuses to shard otherwise), so a block costs
         # each device 1/shard_factor of its total bytes

@@ -127,13 +127,18 @@ def state_shardings(states, mesh: Mesh, axis: str = TP_AXIS):
     (contiguous ``k``/``v``: [n_slots, L, Hkv, Dh]; paged
     ``k_pages``/``v_pages``: [pages, block, Hkv, Dh]) sharded on the
     head axis 2, everything else (``pos``, recurrent h/c) replicated."""
+    from .kvpool import PAGE_KEYS
     repl = NamedSharding(mesh, P())
+    # axis 2 is Hkv for K/V rows ([.., .., Hkv, Dh]) AND for the int8
+    # dequant scale pages ([pages, block, Hkv]) — one spec serves both;
+    # PAGE_KEYS is the single source of truth for what counts as
+    # shared pool storage (a new page-array key lands here for free)
     head = NamedSharding(mesh, P(None, None, axis))
     out = {}
     for key, st in states.items():
         if isinstance(st, dict) and (
                 ("k" in st and "v" in st) or "k_pages" in st):
-            out[key] = {k: (head if k in ("k", "v", "k_pages", "v_pages")
+            out[key] = {k: (head if k in ("k", "v") + PAGE_KEYS
                             else repl) for k in st}
         else:
             out[key] = jax.tree_util.tree_map(lambda _: repl, st)
@@ -205,6 +210,43 @@ def prefill_program_hlo(engine, bucket: Optional[int] = None) -> str:
         lowered = engine._jprefill.lower(
             engine._params, engine._variables, slot0, ids, one,
             engine._states)
+    return lowered.compile().as_text()
+
+
+def verify_program_hlo(engine) -> str:
+    """Compiled HLO of the engine's speculative multi-token VERIFY
+    program (ISSUE 10) with live-dispatch placements — it must obey the
+    same zero-resharding discipline as decode: the chain axis is just a
+    wider T, so the Megatron all-reduce count per block is unchanged."""
+    from .kvpool import SCRATCH_BLOCK
+    ids = engine._dev_array(
+        np.zeros((engine.n_slots, engine.speculate + 1), np.int32))
+    live = engine._dev_array(np.zeros((engine.n_slots,), bool))
+    if engine.paged:
+        nb = engine.table_buckets[0]
+        table = engine._dev_array(
+            np.full((engine.n_slots, nb), SCRATCH_BLOCK, np.int32))
+        lowered = engine._jverify.lower(
+            engine._params, engine._variables, ids, live, table,
+            engine._states)
+    else:
+        lowered = engine._jverify.lower(
+            engine._params, engine._variables, ids, live,
+            engine._states)
+    return lowered.compile().as_text()
+
+
+def draft_program_hlo(engine) -> str:
+    """Compiled HLO of the speculative DRAFT step (the shallow-exit /
+    draft-net single-token forward): a prefix of the target's blocks
+    under the same param specs, so its per-token program is bounded by
+    the same audit — zero resharding, <= 2 all-reduces per draft
+    block."""
+    ids = engine._dev_array(np.zeros((engine.n_slots,), np.int32))
+    live = engine._dev_array(np.zeros((engine.n_slots,), bool))
+    lowered = engine._jdraft_step.lower(
+        engine._draft_params, engine._draft_variables, ids, live,
+        engine._draft_states)
     return lowered.compile().as_text()
 
 

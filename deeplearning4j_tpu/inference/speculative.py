@@ -1,0 +1,271 @@
+"""Speculative decoding support: shallow-exit draft nets, the
+token-identical acceptance rule, and best-of-n COW fork groups.
+
+Speculative decoding (Leviathan et al.; Medusa/EAGLE-style self-drafting)
+attacks the per-token decode cost from ROADMAP item 3: every output token
+of a solo decode pays one full forward pass. A cheap *draft* model
+proposes gamma tokens, and the target model *verifies* all of them in ONE
+multi-token forward (the same per-position-logits machinery chunked
+prefill already built) — accepted tokens cost gamma-plus-one-for-one
+instead of one-for-one.
+
+Three pieces live here because they are engine-independent and unit-
+testable in isolation:
+
+  - :func:`shallow_draft_conf` / :func:`build_shallow_draft` — the
+    SELF-speculative draft: a derived ComputationGraph that runs only the
+    first K transformer blocks of the target and jumps straight to the
+    target's own output head (early exit). Its params are the target's
+    params BY REFERENCE (no copies, no training): the draft is literally
+    the target truncated at depth K, so it costs ~K/N of a forward and
+    needs no separate checkpoint. Requires the pre-LN residual-trunk
+    graph shape `models/zoo.transformer_lm` builds (attention blocks
+    combined through ElementWise residual adds, single-input head
+    chain); anything else must pass an explicit ``draft_net``.
+  - :func:`accept_tokens` — THE acceptance rule. Verification samples
+    from the TARGET distribution at each position with the sequence's
+    own RNG, in order, stopping at the first position whose sampled
+    token diverges from the draft. Because every emitted token is drawn
+    from exactly the distribution (and exactly the RNG state) solo
+    decoding would have used, speculative output is token-identical to
+    non-speculative output BY CONSTRUCTION — greedy and seeded-sampled
+    alike. Draft quality affects only the acceptance rate (speed),
+    never the output.
+  - :class:`ForkGroup` — best-of-n bookkeeping: n candidates over one
+    prompt share the prompt's paged KV blocks through copy-on-write
+    forks (`inference/kvpool.py` block tables + the engine's `_cow_fn`).
+    The first-submitted candidate is the *primary*; followers wait in
+    the queue until the primary's prefill publishes the prompt blocks,
+    then restore them as a zero-copy block-table remap.
+"""
+from __future__ import annotations
+
+import copy
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.sampling import sample_logits
+
+
+class ForkGroup:
+    """Shared bookkeeping for one best-of-n candidate set.
+
+    Threading: constructed by the submitting thread; ``primary_handle``
+    is bound by the FIRST ``engine.submit(..., fork=group)`` (the server
+    submits candidates sequentially, so there is no bind race), and
+    ``published`` is written only by the scheduler thread. Cross-thread
+    readers see GIL-atomic stores; a one-iteration-stale view only
+    delays a follower's restore by one admission pass, never corrupts.
+    The group survives engine crash recovery by riding the supervisor's
+    resubmission kwargs — after a swap, ``published`` may refer to a
+    pool the new engine no longer has, which degrades to a cold prefill
+    (a trie miss), not a deadlock.
+    """
+
+    def __init__(self, n: int):
+        if n < 1:
+            raise ValueError(f"fork group size must be >= 1, got {n}")
+        self.n = int(n)
+        self.published = False
+        self.primary_handle = None
+
+    def bind_primary(self, handle) -> None:
+        """First submitted candidate becomes the primary."""
+        if self.primary_handle is None:
+            self.primary_handle = handle
+
+    def waiting(self, handle) -> bool:
+        """True while ``handle`` (a follower) should stay queued: the
+        primary is still alive and has not yet published the prompt's
+        blocks. A dead/finished primary opens the gate uncondition-
+        ally — followers then prefill cold rather than wait forever."""
+        p = self.primary_handle
+        return (not self.published and p is not None and handle is not p
+                and not p.done())
+
+
+def submit_fork_group(submit: Callable, prompt_ids: Sequence[int], n: int,
+                      max_new_tokens: int, *, seed: int = 0,
+                      request_id: Optional[str] = None, **kw) -> List:
+    """Fan one prompt out into ``n`` fork-group candidates through
+    ``submit`` (the engine's or the supervisor's — THE single home of
+    the best-of-n submission protocol). Candidate i samples with
+    ``seed + i`` and, when a base ``request_id`` is given, carries
+    ``<id>.cI`` so every candidate correlates back to the HTTP
+    request's header id. If a later submit fails (queue full, ladder,
+    engine recovering), every ALREADY-submitted candidate is cancelled
+    before the error propagates — a partial group must not keep
+    decoding into handles nobody holds."""
+    group = ForkGroup(n)
+    handles: List = []
+    try:
+        for i in range(n):
+            handles.append(submit(
+                prompt_ids, max_new_tokens, seed=seed + i, fork=group,
+                request_id=f"{request_id}.c{i}" if request_id else None,
+                **kw))
+    except BaseException:
+        for h in handles:
+            h.cancel()
+        raise
+    return handles
+
+
+def await_fork_group(handles: Sequence, timeout: Optional[float],
+                     clock: Callable[[], float] = time.monotonic) -> None:
+    """Block for every candidate against ONE shared deadline; a timeout
+    cancels all unfinished candidates before propagating (the other
+    half of the submission protocol shared by engine and supervisor)."""
+    deadline = (clock() + timeout) if timeout is not None else None
+    try:
+        for h in handles:
+            h.result(None if deadline is None
+                     else max(0.0, deadline - clock()))
+    except TimeoutError:
+        for h in handles:
+            if not h.done():
+                h.cancel()
+        raise
+
+
+def accept_tokens(rows: np.ndarray, proposals: Sequence[int],
+                  temperature: float, top_k: Optional[int],
+                  top_p: Optional[float], rng: np.random.Generator,
+                  max_tokens: int, eos_id: Optional[int]
+                  ) -> Tuple[List[int], int]:
+    """Token-identical acceptance over one verified chain.
+
+    ``rows``: the target's per-position next-token distributions for the
+    chain ``[last_token, d_1, ..., d_g]`` (``rows[j]`` is the
+    distribution AFTER feeding chain position ``j``; only rows
+    ``0..len(proposals)`` are read). ``proposals``: the g draft tokens.
+
+    Walks the chain sampling from the TARGET distribution with the
+    sequence's own ``rng`` — identical distribution, identical RNG
+    state, identical token to what solo decode would emit at that
+    position. Stops at the first sampled token that diverges from the
+    draft (later rows are conditioned on rejected context), at EOS, or
+    at ``max_tokens``; the final row (all drafts matched) yields one
+    bonus token for free. RNG is never consumed past the stop, so the
+    sequence's sampling stream stays in lockstep with solo decode.
+
+    Returns ``(emitted, matched)``: the 1..g+1 accepted tokens and how
+    many draft proposals they confirmed (the acceptance-rate metric).
+    """
+    g = len(proposals)
+    emitted: List[int] = []
+    matched = 0
+    for j in range(g + 1):
+        if len(emitted) >= max_tokens:
+            break
+        tok = sample_logits(rows[j], temperature, top_k, rng, top_p)
+        emitted.append(tok)
+        if eos_id is not None and tok == eos_id:
+            if j < g and tok == proposals[j]:
+                matched += 1
+            break
+        if j < g:
+            if tok != proposals[j]:
+                break  # rows[j+1:] are conditioned on the rejected draft
+            matched += 1
+    return emitted, matched
+
+
+def shallow_draft_conf(conf, draft_blocks: int):
+    """Derive the early-exit draft configuration: the first
+    ``draft_blocks`` transformer blocks of ``conf`` rewired straight
+    into the target's head chain (final LayerNorm + output layer).
+
+    Structural contract (the `models/zoo.transformer_lm` shape, pre-LN
+    residual stack): attention layers sit behind a single-input
+    normalization vertex whose input is the block's residual-trunk
+    entry, blocks combine through ElementWise vertices, and the output
+    head is a chain of single-input non-ElementWise vertices. Graphs
+    that don't match raise ValueError — the engine then demands an
+    explicit ``draft_net`` or disables speculation with a warning.
+    """
+    from ..nn.conf.graph import ElementWiseVertex, LayerVertex
+
+    order = conf.topological_order()
+    attns = [name for name in order
+             if isinstance(conf.vertices[name], LayerVertex)
+             and type(conf.vertices[name].layer).__name__
+             == "SelfAttentionLayer"]
+    if len(attns) < 2:
+        raise ValueError(
+            f"self-speculative draft needs >= 2 attention blocks to cut "
+            f"between, found {len(attns)}")
+    K = int(draft_blocks)
+    if not 1 <= K < len(attns):
+        raise ValueError(
+            f"draft_blocks={K} must be in [1, {len(attns) - 1}] "
+            f"(the model has {len(attns)} attention blocks)")
+    # block K's trunk entry: the input of the pre-LN feeding attention K
+    ln_k = conf.vertex_inputs[attns[K]][0]
+    entry = conf.vertex_inputs[ln_k][0]
+    if entry not in conf.vertices:
+        raise ValueError(
+            f"block {K}'s trunk entry '{entry}' is a network input — "
+            "nothing to cut")
+    # head chain: back-walk from the output through single-input,
+    # non-residual vertices; stops at the last block's residual combine
+    head: List[str] = []
+    v = conf.network_outputs[0]
+    while (v in conf.vertices
+           and not isinstance(conf.vertices[v], ElementWiseVertex)
+           and len(conf.vertex_inputs.get(v, [])) == 1):
+        head.append(v)
+        v = conf.vertex_inputs[v][0]
+    if not head or not isinstance(conf.vertices.get(v), ElementWiseVertex):
+        raise ValueError(
+            "could not identify the output head chain (expected a "
+            "single-input chain ending at a residual ElementWise vertex)")
+    # keep = everything feeding block K's entry, plus the head chain
+    keep = set(head)
+    stack = [entry]
+    while stack:
+        n = stack.pop()
+        if n in keep or n not in conf.vertices:
+            continue
+        keep.add(n)
+        stack.extend(conf.vertex_inputs.get(n, []))
+    draft = copy.deepcopy(conf)
+    draft.vertices = {n: vx for n, vx in draft.vertices.items() if n in keep}
+    draft.vertex_inputs = {n: list(draft.vertex_inputs[n])
+                           for n in draft.vertices}
+    # the deepest head vertex (e.g. the final LayerNorm) early-exits
+    # from block K's trunk output instead of block N's
+    draft.vertex_inputs[head[-1]] = [entry]
+    for n, ins in draft.vertex_inputs.items():
+        for src in ins:
+            if src not in draft.vertices and src not in draft.network_inputs:
+                raise ValueError(
+                    f"draft surgery left vertex '{n}' referencing removed "
+                    f"vertex '{src}' — graph shape not supported")
+    return draft
+
+
+def build_shallow_draft(net, draft_blocks: int,
+                        max_cache_len: Optional[int] = None):
+    """Materialize the early-exit draft as a ComputationGraph whose
+    params/variables are the TARGET's arrays by reference (zero extra
+    weight bytes; the draft tracks net.params rebinding only at build
+    time — the engine re-reads per dispatch for the unsharded case).
+
+    ``max_cache_len``: override the draft's attention cache capacity
+    (paged engines decode past the target conf's ``max_cache_len``; the
+    draft's private contiguous cache must cover the same depth)."""
+    from ..nn.graph import ComputationGraph
+
+    dconf = shallow_draft_conf(net.conf, draft_blocks)
+    if max_cache_len is not None:
+        for vx in dconf.vertices.values():
+            layer = getattr(vx, "layer", None)
+            if layer is not None and hasattr(layer, "max_cache_len"):
+                layer.max_cache_len = int(max_cache_len)
+    draft = ComputationGraph(dconf).init()
+    draft.params = {name: net.params[name] for name in draft.params}
+    draft.variables = {name: net.variables[name] for name in draft.variables}
+    return draft

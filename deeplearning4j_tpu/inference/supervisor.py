@@ -539,6 +539,30 @@ class EngineSupervisor:
             self._untrack(handle.request_id)
         return handle
 
+    def generate_many(self, prompt_ids: Sequence[int], n: int,
+                      max_new_tokens: int,
+                      timeout: Optional[float] = 120.0, *, seed: int = 0,
+                      **kw) -> List:
+        """Supervised best-of-n (`/generate` with ``n > 1``): the shared
+        `speculative.submit_fork_group` protocol over this supervisor's
+        tracked submit — every candidate is tracked for crash recovery
+        individually (the fork group rides the resubmission kwargs, so
+        recovered candidates keep sharing blocks when the rebuilt
+        engine re-publishes, and degrade to independent prefills when
+        it cannot: correctness never depends on the fork). A partial-
+        submit failure or timeout cancels the submitted candidates;
+        cancelled handles finish at the engine's next sweep and leave
+        the tracking set via `_prune_done`."""
+        from .speculative import await_fork_group, submit_fork_group
+        handles = submit_fork_group(self.submit, prompt_ids, n,
+                                    max_new_tokens, seed=seed, **kw)
+        try:
+            await_fork_group(handles, timeout, clock=self._clock)
+        finally:
+            for h in handles:
+                self._untrack(h.request_id)
+        return handles
+
     def _untrack(self, request_id: str) -> None:
         with self._lock:
             self._tracked.pop(request_id, None)

@@ -291,6 +291,12 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         nb = table.shape[1]
         L = nb * Bk
         wmask = state0.get("wmask")
+        # int8 KV pages (engine kv_dtype="int8"): values quantize on
+        # write against a per-(position, head) max-abs scale stored in
+        # parallel scale pages, and dequantize on the table gather —
+        # under half the pool bytes per block, same step contract
+        ks, vs = state0.get("k_scales"), state0.get("v_scales")
+        quantized = ks is not None
         overflow = (pos + T) > L
         q, k_new, v_new = self._qkv(params, x, pos0=pos)
         p = pos[:, None] + jnp.arange(T, dtype=pos.dtype)[None, :]  # [B, T]
@@ -310,10 +316,32 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
             v_new = jnp.where(keep, v_new, 0)
         blk = jnp.where(p // Bk < nb, blk, 0)  # beyond-table -> scratch
         off = p % Bk
-        kp2 = kp.at[blk, off].set(k_new)
-        vp2 = vp.at[blk, off].set(v_new)
-        kc = kp2[table].reshape(B, L, kp.shape[2], kp.shape[3])
-        vc = vp2[table].reshape(B, L, vp.shape[2], vp.shape[3])
+        if quantized:
+            dt = q.dtype
+
+            def quant(a):  # [B, T, Hkv, Dh] -> int8 rows + f32 scales
+                s = jnp.max(jnp.abs(a), axis=-1) / 127.0
+                s = jnp.maximum(s, jnp.asarray(1e-8, s.dtype))
+                rows = jnp.clip(jnp.round(a / s[..., None]), -127, 127)
+                return rows.astype(jnp.int8), s.astype(jnp.float32)
+
+            kq, ksc = quant(k_new)
+            vq, vsc = quant(v_new)
+            kp2 = kp.at[blk, off].set(kq)
+            vp2 = vp.at[blk, off].set(vq)
+            ks2 = ks.at[blk, off].set(ksc)
+            vs2 = vs.at[blk, off].set(vsc)
+            kc = (kp2[table].astype(dt)
+                  * ks2[table][..., None].astype(dt)).reshape(
+                B, L, kp.shape[2], kp.shape[3])
+            vc = (vp2[table].astype(dt)
+                  * vs2[table][..., None].astype(dt)).reshape(
+                B, L, vp.shape[2], vp.shape[3])
+        else:
+            kp2 = kp.at[blk, off].set(k_new)
+            vp2 = vp.at[blk, off].set(v_new)
+            kc = kp2[table].reshape(B, L, kp.shape[2], kp.shape[3])
+            vc = vp2[table].reshape(B, L, vp.shape[2], vp.shape[3])
         o = self._grouped_attention(q, kc, vc, causal=True, qpos0=pos)
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
@@ -325,4 +353,8 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
         # it is an absolute huge position, not this bucket's cap+1
         next_pos = jnp.where(overflow, jnp.asarray(1 << 30, jnp.int32),
                              pos + T)
-        return y, {"k_pages": kp2, "v_pages": vp2, "pos": next_pos}
+        out_state = {"k_pages": kp2, "v_pages": vp2, "pos": next_pos}
+        if quantized:
+            out_state["k_scales"] = ks2
+            out_state["v_scales"] = vs2
+        return y, out_state

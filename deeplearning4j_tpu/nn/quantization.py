@@ -399,12 +399,7 @@ def quantize_graph(net, calib_batches: Sequence[Any], *, act_dtype=None):
     if act_dtype is None:
         act_dtype = _compute_dtype_of(net.conf.conf)
     conf = net.conf
-    targets: Dict[str, Any] = {}
-    for name, impl in net._impls.items():
-        if isinstance(impl, ConvolutionLayerImpl):
-            targets[name] = "conv"
-        elif type(impl) in (DenseLayerImpl, OutputLayerImpl):
-            targets[name] = "dense"
+    targets = _graph_quant_targets(net)
     calib = list(calib_batches)
     if not calib:
         raise ValueError("quantize_graph() needs at least one calibration batch")
@@ -430,8 +425,33 @@ def quantize_graph(net, calib_batches: Sequence[Any], *, act_dtype=None):
                 x = proc.preprocess(x)
             maxabs[name] = max(maxabs[name], float(jnp.max(jnp.abs(x))))
 
+    x_scales = {name: max(maxabs[name], _EPS) / 127.0 for name in targets}
+    return _build_graph_clone(net, x_scales, act_dtype)
+
+
+def _graph_quant_targets(net) -> Dict[str, str]:
+    """vertex name -> 'conv' | 'dense' for every quantizable vertex —
+    the single target-selection rule shared by `quantize_graph` and the
+    artifact loader (so a persisted scale set can be validated against
+    exactly what a fresh quantization would cover)."""
+    targets: Dict[str, str] = {}
+    for name, impl in net._impls.items():
+        if isinstance(impl, ConvolutionLayerImpl):
+            targets[name] = "conv"
+        elif type(impl) in (DenseLayerImpl, OutputLayerImpl):
+            targets[name] = "dense"
+    return targets
+
+
+def _build_graph_clone(net, x_scales: Dict[str, float], act_dtype):
+    """Assemble the inference-only quantized ComputationGraph clone from
+    a float graph plus per-vertex activation scales (freshly calibrated
+    or reloaded from a `save_quantized_graph` artifact — weight
+    quantization is deterministic from the float params either way)."""
+    targets = _graph_quant_targets(net)
     qimpls = {}
-    for name, kind in targets.items():
+    for name, sx in x_scales.items():
+        kind = targets[name]
         p = net.params[name]
         Wq, w_scale = _weight_qparams(np.asarray(p["W"], np.float64))
         lconf = net._impls[name].conf
@@ -439,8 +459,8 @@ def quantize_graph(net, calib_batches: Sequence[Any], *, act_dtype=None):
                           dilation=lconf.dilation) if kind == "conv" else None)
         qimpls[name] = _QuantizedVertexImpl(
             net._impls[name], kind, Wq, w_scale,
-            np.asarray(p["b"], np.float32),
-            max(maxabs[name], _EPS) / 127.0, conv_args, act_dtype)
+            np.asarray(p["b"], np.float32), float(sx), conv_args,
+            act_dtype)
 
     clone = object.__new__(type(net))
     clone.__dict__.update(net.__dict__)
@@ -448,6 +468,7 @@ def quantize_graph(net, calib_batches: Sequence[Any], *, act_dtype=None):
     clone._jit_cache = {}
     clone._rnn_state = {}  # own decode state — never share the source's
     clone._quantized_vertices = sorted(qimpls)
+    clone._quant_act_dtype = act_dtype
     return clone
 
 
@@ -495,14 +516,68 @@ def save_quantized(qnet: QuantizedNetwork, path) -> None:
         zf.writestr(QUANT_JSON, json.dumps(meta))
 
 
-def load_quantized(path) -> QuantizedNetwork:
-    """Reload a `save_quantized` artifact: restore the float net, rebuild
+def save_quantized_graph(qgraph, path) -> None:
+    """Persist a `quantize_graph` clone: the float graph checkpoint
+    (the clone's conf/params/variables ARE the float ones) plus
+    `quantization.json` with the per-vertex activation scales. Weight
+    quantization rebuilds deterministically from the float params at
+    load time, so the artifact doubles as a valid float checkpoint —
+    `dl4j-tpu serve --int8 --generate` loads it through
+    :func:`load_quantized` and hands the int8 clone straight to the
+    decode scheduler (the attention KV path stays float; only the
+    dense matmuls run s8xs8->s32)."""
+    import zipfile
+    from ..util.model_serializer import write_model
+    names = getattr(qgraph, "_quantized_vertices", None)
+    if not names:
+        raise ValueError("save_quantized_graph() wants a quantize_graph() "
+                         "clone (no quantized vertices found)")
+    act_dtype = getattr(qgraph, "_quant_act_dtype", jnp.float32)
+    dtype_name = np.dtype(act_dtype).name
+    if dtype_name not in _ACT_DTYPES:
+        raise ValueError(
+            f"act_dtype '{dtype_name}' cannot be persisted (supported: "
+            f"{sorted(_ACT_DTYPES)}) — refusing to write an unloadable "
+            "artifact")
+    write_model(qgraph, path)
+    meta = {
+        "facade": "graph",
+        "act_dtype": dtype_name,
+        "x_scales": {name: float(qgraph._impls[name].x_scale)
+                     for name in names},
+    }
+    with zipfile.ZipFile(path, "a", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(QUANT_JSON, json.dumps(meta))
+
+
+def _load_quantized_graph(path, meta):
+    from ..util.model_serializer import restore_computation_graph
+    net = restore_computation_graph(path)
+    act_dtype = _ACT_DTYPES.get(meta["act_dtype"])
+    if act_dtype is None:
+        raise ValueError(f"unsupported act_dtype '{meta['act_dtype']}'")
+    x_scales = {str(k): float(v) for k, v in meta["x_scales"].items()}
+    want = set(_graph_quant_targets(net))
+    if set(x_scales) != want:
+        raise ValueError("quantization plan mismatch: saved scales cover "
+                         f"vertices {sorted(x_scales)} but the restored "
+                         f"graph quantizes {sorted(want)}")
+    return _build_graph_clone(net, x_scales, act_dtype)
+
+
+def load_quantized(path):
+    """Reload a quantized artifact — `save_quantized` (MultiLayerNetwork
+    facade, returns a :class:`QuantizedNetwork`) or
+    `save_quantized_graph` (ComputationGraph facade, returns the
+    inference-only int8 graph clone): restore the float net, rebuild
     the quantization plan deterministically, and install the persisted
     activation scales (no recalibration data needed at load time)."""
     import zipfile
     from ..util.model_serializer import restore_multi_layer_network
     with zipfile.ZipFile(path) as zf:
         meta = json.loads(zf.read(QUANT_JSON).decode())
+    if meta.get("facade") == "graph":
+        return _load_quantized_graph(path, meta)
     if meta.get("facade") != "multilayer":
         raise ValueError(f"not a multilayer quantized artifact: {meta}")
     net = restore_multi_layer_network(path)

@@ -145,7 +145,9 @@ class InferenceServer:
                  decode_vocab: Optional[int] = None, decode_slots: int = 4,
                  prefill_chunk: int = 64, decode_queue: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
-                 kv_pool_mb: float = 0.0, decode_tp: int = 0,
+                 kv_pool_mb: float = 0.0, kv_dtype: Optional[str] = None,
+                 decode_tp: int = 0, speculate: int = 0,
+                 draft_blocks: int = 0, draft_net=None,
                  metrics: Optional[MetricsRegistry] = None,
                  trace_buffer: int = 8192,
                  tracer: Optional[FlightRecorder] = None,
@@ -173,6 +175,14 @@ class InferenceServer:
         self.prefix_cache_mb = float(prefix_cache_mb)
         self.kv_block = int(kv_block)
         self.kv_pool_mb = float(kv_pool_mb)
+        self.kv_dtype = kv_dtype
+        # speculative decoding (ISSUE 10): gamma draft tokens per slot
+        # per iteration, verified token-identically by one multi-token
+        # target forward; draft = shallow exit over the first
+        # `draft_blocks` transformer blocks (or an explicit draft_net)
+        self.speculate = int(speculate)
+        self.draft_blocks = int(draft_blocks)
+        self.draft_net = draft_net
         # tensor-parallel decode (inference/sharding.py): > 1 shards the
         # engine over a tp-device mesh — heads/FFN split, KV pool
         # head-sharded (kv_pool_mb becomes the PER-DEVICE budget), block
@@ -236,7 +246,11 @@ class InferenceServer:
             prefix_cache_mb=self.prefix_cache_mb,
             kv_block=self.kv_block,
             kv_pool_mb=self.kv_pool_mb,
+            kv_dtype=self.kv_dtype,
             mesh=self.decode_tp if self.decode_tp > 1 else None,
+            speculate=self.speculate,
+            draft_blocks=self.draft_blocks or None,
+            draft_net=self.draft_net,
             transfer_guard=self.decode_transfer_guard,
             metrics=self.metrics, tracer=self.tracer)
 
@@ -315,13 +329,42 @@ class InferenceServer:
         kw = {k: payload[k] for k in ("temperature", "top_k", "top_p",
                                       "seed", "eos_id", "priority")
               if k in payload}
+        prompt = [int(t) for t in payload["prompt"]]
+        max_new = int(payload.get("max_new_tokens", 16))
+        timeout = timeout_ms / 1e3 if timeout_ms is not None else 120.0
+        n = int(payload.get("n", 1))
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        if n > 1:
+            # best-of-n: n candidates over one prompt, submitted as a
+            # COW fork group (paged engines share the prompt's blocks —
+            # n candidates, ~one prompt's worth of KV). Candidate i
+            # samples with seed+i; the client ranks the candidates.
+            if n > max(self.decode_slots, 1) * 4:
+                raise ValueError(
+                    f"n={n} exceeds the candidate cap "
+                    f"({max(self.decode_slots, 1) * 4} = 4x decode "
+                    "slots)")
+            handles = gen.generate_many(prompt, n, max_new,
+                                        timeout=timeout,
+                                        request_id=request_id, **kw)
+            return {
+                "tokens": handles[0].tokens,  # n=1-compatible surface
+                "candidates": [
+                    {"tokens": h.tokens, "request_id": h.request_id,
+                     "timings": h.timings()} for h in handles],
+                "n": n,
+                # the handler's id (the X-Request-Id header): candidate
+                # ids derive from it as <id>.cI, so body and header
+                # correlate instead of contradicting
+                "request_id": request_id or handles[0].request_id,
+                "timings": handles[0].timings(),
+            }
         # supervised: the supervisor tracks the request for crash
         # recovery (an engine restart resubmits it, same handle, same
         # seed — the client never sees the crash)
         handle = gen.generate_handle(
-            [int(t) for t in payload["prompt"]],
-            int(payload.get("max_new_tokens", 16)),
-            timeout=timeout_ms / 1e3 if timeout_ms is not None else 120.0,
+            prompt, max_new, timeout=timeout,
             request_id=request_id, **kw)
         # the per-request observability payload: the id the client can
         # quote (X-Request-Id carries it too) and the phase breakdown

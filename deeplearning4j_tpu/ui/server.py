@@ -344,6 +344,12 @@ async function refresh() {
         (c.prefix_cache_evicted_blocks_total ? ' (' +
           c.prefix_cache_evicted_blocks_total + ' blocks evicted)' : '')
         : '') +
+      (c.spec_tokens_proposed_total !== undefined ?  // speculative decode
+        ', spec accept ' +
+        (100 * (r.spec_acceptance_rate || 0)).toFixed(1) + '% of ' +
+        c.spec_tokens_proposed_total + ' drafted' : '') +
+      (c.decode_forks_total ? ', ' + c.decode_forks_total +
+        ' best-of-n forks' : '') +
       (c.decode_cancelled_total ? ', ' + c.decode_cancelled_total +
         ' cancelled' : '');
   const g = m.gauges || {};

@@ -514,3 +514,33 @@ def test_serve_cli_trace_buffer_flag_parses():
     assert args.trace_buffer == 1024
     args = build_parser().parse_args(["serve", "--model", "m.zip"])
     assert args.trace_buffer == 8192
+
+
+def test_speculative_records_on_the_waterfall_chrome_valid():
+    """ISSUE 10: speculation's trace surface — `draft`/`rollback` (and
+    best-of-n `fork`) instants on slot tracks, per-slot `verify` spans
+    on the request waterfall — round-trips the chrome export schema."""
+    rec = FlightRecorder(8192)
+    net = _lm()
+    prompt = [int(t) for t in np.random.default_rng(4).integers(0, 13, 20)]
+    eng = DecodeScheduler(net, 13, n_slots=2, prefill_chunk=16,
+                          kv_pool_mb=2.0, kv_block=4, speculate=3,
+                          metrics=MetricsRegistry(), tracer=rec).start()
+    try:
+        h = eng.generate_handle(prompt, 10, timeout=600)
+    finally:
+        eng.stop()
+    evs = rec.events()
+    names = {e["name"] for e in evs}
+    assert {"draft", "verify", "rollback"} <= names
+    # per-slot draft/rollback instants carry the request id in args
+    drafts = [e for e in evs if e["name"] == "draft"]
+    assert all(e["ph"] == "i" and e["track"].startswith("slot")
+               and "proposed" in e["args"] for e in drafts)
+    # verify spans sit ON the request's waterfall track, B/E paired
+    vb = [e for e in evs if e["name"] == "verify" and e["ph"] == "B"]
+    ve = [e for e in evs if e["name"] == "verify" and e["ph"] == "E"]
+    assert vb and len(vb) == len(ve)
+    assert any(e["track"] == f"request {h.request_id}" for e in vb)
+    assert all("accepted" in e["args"] for e in ve)
+    _validate_chrome(rec.chrome_trace())
