@@ -754,6 +754,112 @@ def bench_trace_overhead(prompt_len=64, new_tokens=24, chunk=32, vocab=64,
     }
 
 
+def bench_profiler_overhead(prompt_len=64, new_tokens=24, chunk=32,
+                            vocab=64, n_reqs=6, rounds=8,
+                            d_model=128) -> dict:
+    """Performance-attribution-plane cost A/B (ISSUE 11 acceptance: the
+    step-phase profiler + SLO monitor stay ON in production, so the
+    armed engine must keep >= 0.95 of the disarmed step time). Two
+    identical d128 decode schedulers drive the same prompts: the ARMED
+    one runs the full plane — per-phase histograms, dispatch counting,
+    the rolling FLOPs/MFU window over a warmup-ingested cost table, and
+    an SLOMonitor observing every completed request with a request-id
+    exemplar (the serving layer's per-route observe) — the DISARMED one
+    is built with profile=False (every profiler stamp reduces to one
+    attribute test) and no SLO observations. Interleaved
+    best-of-``rounds``; the FLOOR metric is the pooled mean scheduler
+    step time (decode_step_time_sec) over the timed phase, the
+    race_audit bench's protocol. Standalone-runnable:
+        python -c "import bench, json; print(json.dumps(bench.bench_profiler_overhead()))"
+    """
+    from deeplearning4j_tpu.inference import (DecodeScheduler,
+                                              MetricsRegistry, SLOMonitor)
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    # d128 like race_audit: the per-iteration profiler overhead is FIXED
+    # (a handful of monotonic reads + dict arithmetic), so the <=5%
+    # budget must be judged against a realistic-model step, not a toy's
+    conf = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = prompt_len + new_tokens
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(7)
+    prompts = [list(rng.integers(0, vocab, prompt_len))
+               for _ in range(n_reqs)]
+
+    def make(profile):
+        eng = DecodeScheduler(net, vocab, n_slots=4, prefill_chunk=chunk,
+                              profile=profile,
+                              metrics=MetricsRegistry()).start()
+        if profile:
+            eng.attribute_costs()  # the warmup-time cost_analysis table
+        for h in [eng.submit(p, 2) for p in prompts]:  # warm/compile
+            h.result(600)
+        return eng
+
+    slo = None
+
+    def run_once(eng, observe):
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, new_tokens) for p in prompts]
+        for h in handles:
+            h.result(600)
+            if observe:  # the serving layer's per-request SLO input
+                slo.observe("/generate", h.timings()["total_ms"] / 1e3,
+                            request_id=h.request_id)
+        return n_reqs * new_tokens / (time.perf_counter() - t0)
+
+    eng_off = make(False)
+    eng_on = make(True)
+    slo = SLOMonitor(objective_p99_s=0.5, metrics=eng_on.metrics)
+
+    def step_state(eng):
+        s = eng.metrics.histogram("decode_step_time_sec").snapshot()
+        return (s.get("count", 0), s.get("sum", 0.0))
+
+    try:
+        base_off, base_on = step_state(eng_off), step_state(eng_on)
+        tps_off = tps_on = 0.0
+        for _ in range(rounds):  # interleaved A/B (host-drift-fair)
+            tps_off = max(tps_off, run_once(eng_off, False))
+            tps_on = max(tps_on, run_once(eng_on, True))
+
+        def timed_mean(eng, base):
+            n, s = step_state(eng)
+            return (s - base[1]) / max(1, n - base[0])
+
+        mean_off = timed_mean(eng_off, base_off)
+        mean_on = timed_mean(eng_on, base_on)
+        rates = eng_on.profiler.rates()
+        n_costed = len(eng_on.profiler.costs)
+    finally:
+        eng_off.stop()
+        eng_on.stop()
+    return {
+        "tokens_per_sec_disarmed": round(tps_off, 1),
+        "tokens_per_sec_armed": round(tps_on, 1),
+        "wall_throughput_ratio": round(tps_on / tps_off, 4),
+        "step_ms_disarmed": round(mean_off * 1e3, 4),
+        "step_ms_armed": round(mean_on * 1e3, 4),
+        "step_time_ratio": round(mean_off / mean_on, 4),
+        "costed_program_families": n_costed,
+        "attributed_tokens_per_sec": rates["tokens_per_sec"],
+        "attributed_mfu": rates["mfu_estimate"],
+        "note": f"{n_reqs} concurrent {prompt_len}-token prompts x "
+                f"{new_tokens} greedy tokens on a 2-block d{d_model} LM, "
+                "4 slots; armed = step-phase profiler + cost attribution "
+                "+ SLOMonitor observing every request (exemplars "
+                "included), disarmed = profile=False; best-of-"
+                f"{rounds} interleaved rounds. Floor: step_time_ratio "
+                "(disarmed/armed pooled mean scheduler-iteration time) "
+                ">= 0.95, the <=5% always-on attribution budget",
+    }
+
+
 def bench_race_audit(prompt_len=64, new_tokens=24, chunk=32, vocab=64,
                      n_reqs=6, rounds=8, d_model=128) -> dict:
     """Race-checker shim cost A/B (ISSUE 8 acceptance: the DISARMED
@@ -1692,6 +1798,12 @@ def main() -> None:
         WORKLOADS["chaos_recovery"] = bench_chaos_recovery()
     except Exception as e:
         WORKLOADS["chaos_recovery"] = {"error": str(e)}
+
+    # ---- serving: profiler+SLO armed-vs-disarmed A/B (ISSUE 11) ---------
+    try:
+        WORKLOADS["profiler_overhead"] = bench_profiler_overhead()
+    except Exception as e:
+        WORKLOADS["profiler_overhead"] = {"error": str(e)}
 
     # ---- analysis: race-checker disarmed-shim-cost A/B (ISSUE 8) --------
     try:

@@ -19,6 +19,7 @@ Usage:
                     [--kv-dtype int8] [--speculate GAMMA]
                     [--draft-blocks K] [--tp N]]
                    [--no-supervise] [--hang-timeout S] [--retry-budget N]
+                   [--slo-p99-ms MS] [--no-profiler]
                    [--failpoint NAME=SPEC ...] [--failpoint-endpoint]
 """
 from __future__ import annotations
@@ -123,6 +124,8 @@ def cmd_serve(args) -> int:
               supervise=not args.no_supervise,
               hang_timeout_s=args.hang_timeout,
               retry_budget=args.retry_budget,
+              slo_p99_ms=args.slo_p99_ms,
+              profile=not args.no_profiler,
               failpoint_endpoint=args.failpoint_endpoint)
     # chaos seams: --failpoint flags, then the environment
     # (DL4J_FAILPOINTS="name=spec;..."), both through the same parser
@@ -210,12 +213,17 @@ def cmd_serve(args) -> int:
                    f"(block {args.kv_block})")
     else:
         kv_mode = ", prefix cache OFF"
+    slo_mode = (f", SLO p99<={args.slo_p99_ms:g}ms (burn-rate fed to "
+                "the degradation ladder)" if args.slo_p99_ms else "")
+    prof_mode = ("" if not args.no_profiler
+                 else ", profiler OFF (no phase/MFU attribution)")
     gen_mode = (f"; /generate: {args.decode_slots} slots, "
                 f"prefill chunk {args.prefill_chunk}" + kv_mode
                 + spec_mode + mesh_mode
                 + (f", supervised (hang timeout {args.hang_timeout}s, "
                    f"retry budget {args.retry_budget})"
                    if not args.no_supervise else ", UNSUPERVISED")
+                + slo_mode + prof_mode
                 if args.generate else "")
     chaos = (f"; failpoints ARMED: {', '.join(armed)}" if armed else "")
     print(f"Serving {args.model} ({mode}, {batch_mode}{gen_mode}{chaos}) "
@@ -362,6 +370,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--retry-budget", type=int, default=3,
                    help="submissions allowed per request across engine "
                         "crashes before it fails with a structured 503")
+    s.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="p99 latency objective (ms) for the SLO monitor: "
+                        "per-route sliding-window percentiles + fast/"
+                        "slow-window burn rates on /metrics, and a "
+                        "sustained burn escalates the degradation "
+                        "ladder alongside queue pressure (default: "
+                        "track percentiles only, never escalate)")
+    s.add_argument("--no-profiler", action="store_true",
+                   help="disarm the step-phase profiler + cost "
+                        "attribution (no per-phase step decomposition, "
+                        "no FLOPs/MFU gauges; <=5%% overhead when on, "
+                        "bench-gated)")
     s.add_argument("--failpoint", action="append", metavar="NAME=SPEC",
                    help="arm a chaos seam, e.g. "
                         "dispatch.decode=crash@n:3 or "

@@ -29,6 +29,7 @@ from .failpoints import (InjectedCrash, InjectedFault, InjectedHang,
 from .kvpool import KVPool
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry)
+from .profiler import SLOMonitor, StepPhaseProfiler, program_costs
 from .sharding import (TP_AXIS, collective_counts, decode_mesh,
                        decode_program_hlo, draft_program_hlo,
                        prefill_program_hlo, verify_program_hlo)
@@ -44,9 +45,10 @@ __all__ = ["AdmissionRejectedError", "Counter", "DecodeHandle",
            "InjectedCrash", "InjectedFault", "InjectedHang", "InjectedOOM",
            "KVPool", "LoadSheddedError", "MetricsRegistry", "MicroBatcher",
            "PromptTooLongError", "QueueFullError", "RequestTimeoutError",
-           "RetryBudgetExceededError", "ShuttingDownError", "TP_AXIS",
+           "RetryBudgetExceededError", "SLOMonitor", "ShuttingDownError",
+           "StepPhaseProfiler", "TP_AXIS",
            "bucket_for", "build_shallow_draft", "collective_counts",
            "decode_mesh", "decode_program_hlo", "default_recorder",
            "default_registry", "draft_program_hlo",
            "new_request_id", "pow2_buckets", "prefill_program_hlo",
-           "verify_program_hlo"]
+           "program_costs", "verify_program_hlo"]

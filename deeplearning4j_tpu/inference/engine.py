@@ -106,6 +106,7 @@ from .batcher import QueueFullError, bucket_for, pow2_buckets
 from .kvpool import (PAGE_KEYS, SCRATCH_BLOCK, KVPool, gather_blocks,
                      scatter_blocks)
 from .metrics import MetricsRegistry, default_registry
+from .profiler import StepPhaseProfiler, program_costs
 from .sharding import (TP_AXIS, decode_mesh, kv_heads_shardable,
                        shard_decode_params, state_shardings,
                        storage_shardings)
@@ -437,6 +438,8 @@ class DecodeScheduler:
                  draft_blocks: Optional[int] = None, draft_net=None,
                  metrics: Optional[MetricsRegistry] = None,
                  tracer: Optional[FlightRecorder] = None,
+                 profiler: Optional[StepPhaseProfiler] = None,
+                 profile: bool = True,
                  transfer_guard: Optional[str] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
@@ -456,6 +459,20 @@ class DecodeScheduler:
         # recorder must not interleave same-name spans on "scheduler"/
         # "slot N" tracks (the export pairs B/E LIFO per track)
         self.tracer = tracer if tracer is not None else default_recorder()
+        # step-phase profiler + cost attribution (profiler.py, ISSUE 11):
+        # per-iteration phase decomposition and the rolling FLOPs/MFU
+        # window. Single-writer state written by the scheduler thread
+        # only (the flight recorder's discipline); profile=False (or an
+        # injected disabled profiler) reduces every stamp to one
+        # attribute test — the bench-gated disarmed configuration
+        self.profiler = profiler if profiler is not None else \
+            StepPhaseProfiler(self.metrics, enabled=bool(profile))
+        # serializes attribute_costs' seconds-long first computation:
+        # two concurrent /debug/engine reads must not both trace the
+        # whole program family (never touched by the scheduler thread)
+        self._attr_lock = threading.Lock()
+        self._attr_failed = False  # one-shot: a backend without a cost
+        # model fails ONCE, not seconds of re-tracing per /debug poll
         sfx = self.tracer.track_scope("engine")
         self._sched_track = "scheduler" + sfx
         self._slot_tracks = [f"slot {i}{sfx}" for i in range(self.n_slots)]
@@ -2209,6 +2226,7 @@ class DecodeScheduler:
             ids = np.zeros((bucket,), np.int32)
             ids[:n_real] = seq.prompt[seq.fed:seq.fed + n_real]
             failpoints.fire("dispatch.prefill")
+            self.profiler.count("prefill", bucket)
             if self.tracer.enabled:  # keep tracing-off allocation-free
                 self.tracer.begin("prefill_chunk",
                                   track=self._slot_tracks[i],
@@ -2239,6 +2257,7 @@ class DecodeScheduler:
                 # on TTFT. A restore-jumped sequence is out of sync
                 # (draft_fed < fed) and catches up via
                 # _run_draft_catchup instead.
+                self.profiler.count("draft_prefill", bucket)
                 _, self._draft_states = self._jdraft_prefill(
                     self._draft_params, self._draft_variables,
                     self._dev_index(i), self._dev_array(ids),
@@ -2314,6 +2333,7 @@ class DecodeScheduler:
             full = seq.full_context()
             ids = np.zeros((bucket,), np.int32)
             ids[:n_real] = full[seq.draft_fed:seq.draft_fed + n_real]
+            self.profiler.count("draft_prefill", bucket)
             _, self._draft_states = self._jdraft_prefill(
                 self._draft_params, self._draft_variables,
                 self._dev_index(i), self._dev_array(ids),
@@ -2377,6 +2397,7 @@ class DecodeScheduler:
             ids = np.zeros((self.n_slots,), np.int32)
             for i, seq, known, lag, tail, props in info:
                 ids[i] = tail[r] if r < lag else props[r - lag]
+            self.profiler.count("draft", 0)
             dprobs, self._draft_states = self._jdraft_step(
                 dp, dv, self._dev_array(ids), ldev, self._draft_states)
             rows = host_read(dprobs)
@@ -2402,10 +2423,12 @@ class DecodeScheduler:
         if self.paged:
             table = self._table_for(max(s.written + G + 1
                                         for _, s, _k, _l, _t, _p in info))
+            self.profiler.count("verify", table.shape[1])
             vprobs, self._states = self._jverify(
                 self._params, self._variables, self._dev_array(ids2),
                 ldev, self._dev_array(table), self._states)
         else:
+            self.profiler.count("verify", 0)
             vprobs, self._states = self._jverify(
                 self._params, self._variables, self._dev_array(ids2),
                 ldev, self._states)
@@ -2464,6 +2487,8 @@ class DecodeScheduler:
         if self._fenced:
             raise _EngineFenced
         failpoints.fire("scheduler.iteration")
+        prof = self.profiler
+        prof.iter_begin()
         self._evict_cancelled()
         self._admit()
         # single-writer: _slots is mutated only by this scheduler thread
@@ -2472,11 +2497,15 @@ class DecodeScheduler:
         active = [(i, s) for i, s in enumerate(self._slots)  # graftlint: disable=CC004
                   if s is not None]
         if not active:
-            return False
+            return False  # idle pass: no laps recorded (a 10 Hz idle
+            # wake stamping µs admit laps would swamp the histograms)
+        prof.lap("admit")
         t0 = time.monotonic()
         self._emitted_this_iter = 0
         chunked = self._run_prefill_chunk()
+        prof.lap("prefill")
         self._run_draft_catchup()
+        prof.lap("draft")
         # decode step: every decode-ready slot, plus token-by-token
         # prefill for slots chunked prefill cannot serve (disabled, or
         # no bucket fits the remaining cache headroom). With speculation
@@ -2506,6 +2535,7 @@ class DecodeScheduler:
                         or not self._ensure_writable(i, seq, seq.written):
                     continue  # seq itself was preempted for blocks
             (spec if want > 1 else fed).append((i, seq))
+        prof.lap("pool")
         if fed:
             ids = np.zeros((self.n_slots,), np.int32)
             live = np.zeros((self.n_slots,), bool)
@@ -2519,16 +2549,19 @@ class DecodeScheduler:
             if self.paged:
                 table = self._table_for(max(s.written + 1
                                             for _, s in fed))
+                prof.count("decode", table.shape[1])
                 probs, new_states = self._jstep(
                     self._params, self._variables, self._dev_array(ids),
                     self._dev_array(live), self._dev_array(table),
                     self._states)
             else:
+                prof.count("decode", 0)
                 probs, new_states = self._jstep(
                     self._params, self._variables, self._dev_array(ids),
                     self._dev_array(live), self._states)
             self._states = new_states
             probs = host_read(probs)
+            prof.lap("decode")
             for i, seq in fed:
                 seq.steps += 1
                 seq.written += 1
@@ -2539,13 +2572,16 @@ class DecodeScheduler:
                     continue  # still prefilling; output not sampled yet
                 self._consume(i, seq, probs[i])
             self.tracer.end("decode_step", track=self._sched_track)
+        prof.lap("accept")
         if spec:
             self._run_speculation(spec)
+        prof.lap("verify")
         if self._emitted_this_iter:
             self._m_tokens.inc(self._emitted_this_iter)
         self._m_occupancy.record(len(active))
         self._m_step_time.record(time.monotonic() - t0)
         self._trace_compiles()
+        prof.iter_end(tokens=self._emitted_this_iter)
         return True
 
     def _trace_compiles(self) -> None:
@@ -2585,8 +2621,16 @@ class DecodeScheduler:
                 # work onto a rebuilt engine) or failed fast
                 self._crash(e)
                 return
-            self.iterations += 1
+            # single-writer int bump; lock-free readers (the watchdog's
+            # warmup-grace check, debug_snapshot) take a GIL-atomic
+            # value one iteration stale at worst — the documented
+            # diagnostics-read contract
+            self.iterations += 1  # graftlint: disable=CC005
             if not stepped:
+                # idle pass: decay the rate gauges (iter_end never runs
+                # here, and frozen gauges would report the last burst's
+                # tokens/s and MFU on an hour-idle engine)
+                self.profiler.idle_tick()
                 with self._cond:
                     if not self._running:
                         return
@@ -2784,6 +2828,109 @@ class DecodeScheduler:
             nomask = self._dev_array(np.zeros((self.n_slots,), bool))
             self._jfixpos(self._states, posv, nomask)
             self._jdraft_fixpos(self._draft_states, posv, nomask)
+        if self.profiler.enabled and not self.profiler.costs:
+            # a REBUILT engine (supervisor crash recovery / drain swap
+            # over the same net) re-ingests the process-wide cached
+            # cost table here for free, so post-recovery traffic gets
+            # MFU attribution immediately. The FIRST computation is
+            # deliberately lazy (first /debug/engine read, bench, or an
+            # explicit attribute_costs()) — tracing the whole program
+            # family for cost analysis costs seconds on many-bucket
+            # paged engines, and warmup's job is keeping the recovery
+            # window tight, not paying optional analysis up front.
+            from .profiler import cached_program_costs
+            cached = cached_program_costs(self)
+            if cached:
+                self.profiler.ingest_costs(cached)
+
+    def attribute_costs(self) -> None:
+        """Lower every program family through the XLA cost model
+        (`profiler.program_costs` — the AOT ``.lower()`` path, which
+        never touches the jit call caches, so CompileCounter budgets
+        are unaffected) and hand the per-invocation FLOPs/bytes table
+        to the step-phase profiler. Computed once per (net, engine
+        shape) process-wide; rebuilt engines re-ingest the cached table
+        at warmup. Called lazily from :meth:`debug_snapshot`, eagerly
+        by the bench and anyone who wants MFU before the first debug
+        read. Best-effort: a backend without a cost model just leaves
+        MFU at 0, it never breaks serving."""
+        if not self.profiler.enabled:
+            return
+        with self._attr_lock:  # one tracer; losers reuse its table
+            if self.profiler.costs or self._attr_failed:
+                return
+            try:
+                self.profiler.ingest_costs(program_costs(self))
+            except Exception as e:
+                # memoized: /debug/engine is a POLL endpoint, and
+                # re-tracing the whole family per poll only to fail
+                # again would cost seconds of CPU forever
+                self._attr_failed = True
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "cost_attribution_skipped",
+                        track=self._sched_track,
+                        args={"error": type(e).__name__,
+                              "detail": str(e)[:200]})
+
+    def debug_snapshot(self) -> dict:
+        """`GET /debug/engine`: one JSON view of the engine's live
+        anatomy — slot table, queue, block-pool occupancy + trie stats,
+        compile-cache census, speculative acceptance, mesh topology,
+        per-family program costs and the rolling MFU/tokens-per-second
+        estimates, and the step-phase decomposition.
+
+        Read-side contract: called from HTTP handler threads against
+        scheduler-thread-owned state, every read is a GIL-atomic
+        ref/scalar load and the view is tolerant of being one iteration
+        stale (the same discipline as `inflight()` and the supervisor's
+        `status()`); the pool's trie walk is guarded because the
+        scheduler may grow the trie mid-iteration."""
+        slots = []
+        for i, seq in enumerate(list(self._slots)):  # graftlint: disable=CC004,CC005
+            if seq is None:
+                slots.append(None)
+                continue
+            h = seq.handle
+            slots.append({
+                "slot": i, "request_id": h.request_id,
+                "phase": seq.phase,
+                "prompt_tokens": len(seq.prompt),
+                "fed": seq.fed, "written": seq.written,
+                "tokens_out": len(h.tokens),
+                "max_new_tokens": h.max_new_tokens,
+                "blocks": len(seq.block_ids),
+                "resumed": seq.resumed,
+            })
+        out = {
+            "n_slots": self.n_slots,
+            "paged": self.paged,
+            "iterations": self.iterations,
+            "queue_depth": self.queue_depth(),
+            "slots": slots,
+            "compile_cache": self._compile_counter.counts(),
+            "mesh": {"tp": self.tp},
+            "chunk_cap": self.chunk_cap,
+        }
+        if self.pool is not None:
+            try:
+                out["pool"] = self.pool.stats()
+            except RuntimeError:
+                # trie mutated mid-walk (dict changed size): a refresh
+                # one poll later sees a settled view
+                out["pool"] = {"error": "pool busy, retry"}
+        if self.speculate:
+            out["speculative"] = {
+                "gamma": self.speculate,
+                "draft_blocks": self.draft_blocks,
+                "proposed": self._m_spec_proposed.value,
+                "accepted": self._m_spec_accepted.value,
+            }
+        self.attribute_costs()  # lazy for never-warmed engines
+        if self.profiler.enabled:
+            out["costs"] = self.profiler.cost_snapshot()
+            out["phases"] = self.profiler.decomposition()
+        return out
 
     def shed_queued(self, target_depth: int) -> int:
         """Degradation ladder level >= 1: drop queued (never admitted)

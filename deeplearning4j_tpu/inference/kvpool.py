@@ -258,6 +258,32 @@ class KVPool:
         """block_id -> live sequence references on its node."""
         return {n.block_id: n.lock for n in self._walk() if n.lock}
 
+    def stats(self) -> dict:
+        """One JSON-able occupancy/trie census for `GET /debug/engine`:
+        block accounting plus the prefix index's shape (node count =
+        indexed blocks, pinned refs, max chain depth). O(trie) — a
+        diagnostics read, not a hot-path one."""
+        nodes = depth = refs = 0
+        stack = [(c, 1) for c in self._root.children.values()]
+        while stack:
+            n, d = stack.pop()
+            nodes += 1
+            refs += n.lock
+            depth = max(depth, d)
+            stack.extend((c, d + 1) for c in n.children.values())
+        return {
+            "capacity_blocks": self.capacity_blocks,
+            "block_positions": self.block,
+            "bytes_per_block": self.bytes_per_block,
+            "free_blocks": len(self._free),
+            "used_blocks": self.used_blocks,
+            "utilization": round(
+                self.used_blocks / self.capacity_blocks, 4)
+            if self.capacity_blocks else 0.0,
+            "trie": {"nodes": nodes, "max_depth_blocks": depth,
+                     "pinned_refs": refs},
+        }
+
     def _walk(self):
         stack = list(self._root.children.values())
         while stack:

@@ -33,13 +33,17 @@ exponentially with seeded jitter; each request carries a retry budget,
 and exhaustion fails it with :class:`RetryBudgetExceededError` (the
 serving layer's structured 503 carrying the ``request_id``).
 
-**Graceful degradation.** Sustained queue pressure walks a ladder:
+**Graceful degradation.** Sustained pressure walks a ladder:
 level 1 sheds the lowest-priority queued load (``LoadSheddedError`` →
 retryable 503), level 2 additionally halves the prefill chunk cap
 (shorter device holds; the smaller pow2 buckets are already compiled),
 level 3 rejects new admissions with :class:`AdmissionRejectedError`
-(503 + ``Retry-After``). Pressure easing walks back down. The current
-rung is the ``degradation_level`` gauge.
+(503 + ``Retry-After``). TWO escalation inputs (ISSUE 11): queue depth
+against the shed watermark, and — with ``slo=`` a
+`profiler.SLOMonitor` — the latency-budget burn rate, so a fleet whose
+queue is short but whose p99 is burning the SLO still degrades before
+it melts. Easing on BOTH inputs walks back down. The current rung is
+the ``degradation_level`` gauge.
 
 **Draining restart** (``/admin/drain``): stop admitting, let in-flight
 work finish, swap in a fresh engine, resume — a zero-dropped-request
@@ -160,6 +164,7 @@ class EngineSupervisor:
                  calm_watermark: float = 0.25,
                  ladder_patience: int = 3,
                  retry_after_s: float = 1.0,
+                 slo=None,
                  metrics: Optional[MetricsRegistry] = None,
                  tracer: Optional[FlightRecorder] = None,
                  clock: Callable[[], float] = time.monotonic,
@@ -187,6 +192,12 @@ class EngineSupervisor:
         self.calm_watermark = float(calm_watermark)
         self.ladder_patience = int(ladder_patience)
         self.retry_after_s = float(retry_after_s)
+        # latency-SLO escalation input (profiler.SLOMonitor, ISSUE 11):
+        # the ladder walks up on sustained queue pressure OR a sustained
+        # latency-budget burn, and walks down only when BOTH are calm —
+        # two independent inputs, one rung, no flapping when one input
+        # oscillates around its watermark while the other holds it up
+        self._slo = slo
         self.metrics = metrics if metrics is not None else default_registry()
         self.tracer = tracer if tracer is not None else default_recorder()
         self._clock = clock
@@ -432,11 +443,22 @@ class EngineSupervisor:
 
 # -- degradation ladder ------------------------------------------------
     def _evaluate_ladder(self, eng: DecodeScheduler) -> None:
+        """One ladder evaluation over BOTH escalation inputs: queue
+        pressure (the fraction of max_queue waiting) and — when an
+        `profiler.SLOMonitor` is attached — the latency-budget burn
+        rate. Either input hot counts a pressure hit; de-escalation
+        needs every input calm (queue at-or-under the calm watermark
+        AND latency back inside budget), so a rung held up by latency
+        cannot flap just because the queue drained, and vice versa.
+        The patience counters debounce both directions unchanged."""
         frac = eng.queue_depth() / max(1, eng.max_queue)
-        if frac >= self.shed_watermark:
+        burning, latency_calm = (
+            self._slo.pressure(self._clock())
+            if self._slo is not None else (False, True))
+        if frac >= self.shed_watermark or burning:
             self._pressure_hits += 1
             self._calm_hits = 0
-        elif frac <= self.calm_watermark:
+        elif frac <= self.calm_watermark and latency_calm:
             self._calm_hits += 1
             self._pressure_hits = 0
         else:
@@ -444,7 +466,9 @@ class EngineSupervisor:
             self._calm_hits = 0
         if self._pressure_hits >= self.ladder_patience \
                 and self.degradation_level < 3:
-            self._set_level(self.degradation_level + 1)
+            self._set_level(self.degradation_level + 1,
+                            source="latency" if burning
+                            and frac < self.shed_watermark else "queue")
             self._pressure_hits = 0
         elif self._calm_hits >= self.ladder_patience \
                 and self.degradation_level > 0:
@@ -455,12 +479,12 @@ class EngineSupervisor:
             if shed:
                 self._m_shed.inc(shed)
 
-    def _set_level(self, level: int) -> None:
+    def _set_level(self, level: int, source: str = "queue") -> None:
         self.degradation_level = level
         self._g_level.set(level)
         self._apply_degradation(self.engine, level)
         self.tracer.instant("degrade", track="supervisor",
-                            args={"level": level})
+                            args={"level": level, "input": source})
 
     @staticmethod
     def _apply_degradation(eng: DecodeScheduler, level: int) -> None:
@@ -604,7 +628,7 @@ class EngineSupervisor:
         flip stale is fine; blocking /readyz on the seconds-long
         recovery lock hold is not."""
         eng = self.engine
-        return {
+        out = {
             "ready": self.ready,
             "draining": self._draining,
             "recovering": self._recovering,
@@ -613,6 +637,12 @@ class EngineSupervisor:
             "heartbeat_age_s": round(self._clock() - eng.heartbeat, 3),
             "inflight": len(self._tracked),  # graftlint: disable=CC005 — atomic len(), see docstring
         }
+        if self._slo is not None:
+            # the BRIEF form: /readyz is polled constantly, and the
+            # full snapshot sorts every route's window per call — the
+            # per-route percentiles live on /info and /debug/engine
+            out["slo"] = self._slo.brief()
+        return out
 
     def drain(self, timeout: Optional[float] = None,
               poll_s: float = 0.02) -> bool:

@@ -65,7 +65,13 @@ waterfall: one row per slot showing interleaved prefill chunks, one row
 per request showing its queued/prefill/decode life.
 
 Exports:
-  - ``snapshot(limit)``    -> JSON-able dict (``GET /trace?limit=N``)
+  - ``snapshot(limit)``    -> JSON-able dict (``GET /trace?limit=N``);
+                              ``snapshot(since=cursor)`` /
+                              ``export(since=)`` tail the ring
+                              incrementally — every response carries a
+                              ``next_cursor`` the next poll passes back
+                              (``GET /trace?since=N``), so pollers pay
+                              O(new events), not O(ring)
   - ``chrome_trace(limit)``-> Chrome trace-event JSON, Perfetto-loadable
                               (``GET /trace?format=chrome``); every ``B``
                               is closed by a matching ``E`` even when the
@@ -187,16 +193,58 @@ class FlightRecorder:
             out.append(e)
         return out
 
-    def snapshot(self, limit: Optional[int] = None) -> dict:
+    def snapshot(self, limit: Optional[int] = None,
+                 since: Optional[int] = None) -> dict:
         """``GET /trace`` body: the events plus ring accounting (how many
-        records ever written, how many the ring has since overwritten)."""
+        records ever written, how many the ring has since overwritten).
+
+        ``since``: incremental-tail cursor — only events with ``seq >=
+        since`` are returned, and the response's ``next_cursor`` is what
+        the next poll should pass as ``since`` (`GET /trace?since=N`):
+        the UI and external pollers tail the ring in O(new events)
+        instead of re-downloading the whole buffer each poll. A cursor
+        that fell behind the ring (older than ``total_recorded -
+        capacity``) silently returns the oldest surviving events — the
+        ``dropped`` delta tells the poller what it missed.
+
+        Best-effort like every read of this lock-free ring: seq claim
+        and slot store are two steps, so a writer preempted between
+        them holds a seq BELOW a later writer's already-visible record;
+        a poll snapshotting in that sub-microsecond window advances
+        ``next_cursor`` past the in-flight seq and the tail never
+        delivers it (the same class of loss as ring overwrite — the
+        recorder trades completeness for its zero-lock hot path, and a
+        full re-download shows the record)."""
         evs = self.events()
         total = (max(e["seq"] for e in evs) + 1) if evs else 0
-        if limit is not None and limit > 0:
+        cursor = total
+        if since is not None and since >= 0:
+            # since=0 is the documented INITIAL cursor and must take
+            # this branch: falling through to the legacy newest-N limit
+            # semantics would silently skip the oldest events on the
+            # very first page of a tail
+            evs = [e for e in evs if e["seq"] >= since]
+            if limit is not None and 0 < limit < len(evs):
+                # cursor mode pages FORWARD: keep the OLDEST N so the
+                # next poll's since resumes exactly after the last
+                # returned event — keeping the newest N here (the
+                # legacy limit semantics) would silently skip the
+                # middle of a burst and next_cursor would paper over it
+                evs = evs[:limit]
+                cursor = max(e["seq"] for e in evs) + 1
+        elif limit is not None and limit > 0:
             evs = evs[-limit:]
         return {"capacity": self.capacity, "total_recorded": total,
                 "dropped": max(0, total - self.capacity),
+                "next_cursor": cursor,
                 "events": evs}
+
+    def export(self, since: Optional[int] = None,
+               limit: Optional[int] = None) -> dict:
+        """Cursor-first alias of :meth:`snapshot` for programmatic
+        pollers: ``cur = 0;  while ...: batch = tracer.export(since=cur);
+        cur = batch["next_cursor"]`` tails the ring incrementally."""
+        return self.snapshot(limit=limit, since=since)
 
     def clear(self) -> None:
         """Reset the ring (tests / between bench rounds). Not safe

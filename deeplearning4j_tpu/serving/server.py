@@ -79,10 +79,23 @@ Endpoints:
   GET  /healthz           liveness: process answers (always 200)
   GET  /readyz            readiness: 200 while heartbeat fresh AND not
                           draining/recovering, else 503 (+ status body)
-  GET  /info              model summary + config JSON
-  GET  /metrics           SLO metrics snapshot (?format=text for a
-                          Prometheus-flavored exposition)
+  GET  /info              model summary + config JSON + SLO/profiler
+                          headline (tokens/s, MFU estimate)
+  GET  /metrics           SLO metrics snapshot (?format=prometheus — or
+                          an Accept: application/openmetrics-text
+                          scrape — for the OpenMetrics exposition with
+                          HELP/TYPE, labels, buckets, and request-id
+                          exemplars; Accept: text/plain gets the same
+                          families as 0.0.4 text, exemplars omitted;
+                          ?format=text for the legacy summary text)
+  GET  /debug/engine      live engine anatomy: slot table, pool/trie
+                          occupancy, compile-cache census, spec
+                          acceptance, mesh, per-family FLOPs/bytes from
+                          cost_analysis(), MFU/tokens-per-sec estimates,
+                          step-phase decomposition, supervisor+SLO state
   GET  /trace             flight-recorder dump (?limit=N newest events;
+                          ?since=CURSOR tails incrementally — pass the
+                          previous response's next_cursor;
                           ?format=chrome for Perfetto / chrome://tracing)
   POST /predict           {"data": [[...], ...]}  -> probabilities + argmax
                           (?timeout_ms=N sets the request deadline; an
@@ -110,6 +123,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Dict, Optional, Tuple, Union
@@ -121,7 +135,7 @@ from ..inference import (AdmissionRejectedError, DecodeScheduler,
                          EngineSupervisor, MetricsRegistry, MicroBatcher,
                          PromptTooLongError, QueueFullError,
                          RequestTimeoutError, RetryBudgetExceededError,
-                         ShuttingDownError, failpoints)
+                         SLOMonitor, ShuttingDownError, failpoints)
 from ..inference.failpoints import InjectedFault
 from ..inference.trace import FlightRecorder, new_request_id
 from .streaming import RecordToDataSetConverter
@@ -153,6 +167,9 @@ class InferenceServer:
                  tracer: Optional[FlightRecorder] = None,
                  supervise: bool = True, hang_timeout_s: float = 5.0,
                  retry_budget: int = 3,
+                 slo_p99_ms: Optional[float] = None,
+                 slo: Optional[SLOMonitor] = None,
+                 profile: bool = True,
                  decode_transfer_guard: Optional[str] = None,
                  failpoint_endpoint: bool = False):
         if net is None:
@@ -206,6 +223,16 @@ class InferenceServer:
         self._decoder_direct: Optional[DecodeScheduler] = None
         self._shutting_down = False
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # performance-attribution & SLO plane (inference/profiler.py,
+        # ISSUE 11): per-route sliding-window latency percentiles +
+        # burn-rate against the --slo-p99-ms objective (None = track
+        # percentiles, never burn), fed to the degradation ladder as its
+        # second escalation input; profile=False disarms the engine's
+        # step-phase profiler (the bench A/B knob)
+        self.slo = slo if slo is not None else SLOMonitor(
+            objective_p99_s=slo_p99_ms / 1e3 if slo_p99_ms else None,
+            metrics=self.metrics)
+        self.profile = bool(profile)
         # per-server flight recorder (like the per-server MetricsRegistry:
         # one source of truth this server's `GET /trace` reads back);
         # trace_buffer=0 disables recording entirely (`--trace-buffer 0`)
@@ -252,6 +279,7 @@ class InferenceServer:
             draft_blocks=self.draft_blocks or None,
             draft_net=self.draft_net,
             transfer_guard=self.decode_transfer_guard,
+            profile=self.profile,
             metrics=self.metrics, tracer=self.tracer)
 
     def ready(self) -> Tuple[bool, dict]:
@@ -385,6 +413,7 @@ class InferenceServer:
                     self._decoder_factory,
                     hang_timeout_s=self.hang_timeout_s,
                     retry_budget=self.retry_budget,
+                    slo=self.slo,
                     metrics=self.metrics, tracer=self.tracer)
             else:
                 self._decoder_direct = self._decoder_factory().start()
@@ -442,31 +471,85 @@ class InferenceServer:
                 elif url.path == "/info":
                     import jax  # mesh topology: visible vs used devices
                     dec = server._decoder
-                    self._send({"model": type(server.net).__name__,
-                                "config": json.loads(server.net.conf.to_json()),
-                                "params": server.net.num_params(),
-                                "batching": server.batching,
-                                "mesh": {"devices": len(jax.devices()),
-                                         "tp": getattr(dec, "tp", 1)}})
+                    body = {"model": type(server.net).__name__,
+                            "config": json.loads(server.net.conf.to_json()),
+                            "params": server.net.num_params(),
+                            "batching": server.batching,
+                            "mesh": {"devices": len(jax.devices()),
+                                     "tp": getattr(dec, "tp", 1)},
+                            "slo": server.slo.snapshot()}
+                    prof = getattr(dec, "profiler", None)
+                    if prof is not None and prof.enabled:
+                        # the attribution headline (full detail lives at
+                        # GET /debug/engine): rolling tokens/s, MFU
+                        # estimate, attributed FLOP/s and HBM traffic
+                        body["profiler"] = prof.rates()
+                    self._send(body)
                 elif url.path == "/metrics":
                     q = parse_qs(url.query)
-                    if q.get("format", [""])[0] == "text":
+                    fmt = q.get("format", [""])[0]
+                    accept = self.headers.get("Accept", "") or ""
+                    if fmt == "text":
                         self._send(server.metrics.render_text().encode(),
                                    content_type="text/plain; version=0.0.4")
+                    elif fmt == "prometheus" or (
+                            not fmt and "openmetrics" in accept):
+                        # explicit ?format=prometheus or an OpenMetrics
+                        # scrape: the full exposition WITH exemplars +
+                        # '# EOF', under the openmetrics content type
+                        # (exemplars are only legal in that format)
+                        self._send(
+                            server.metrics.render_prometheus().encode(),
+                            content_type="application/openmetrics-text; "
+                                         "version=1.0.0; charset=utf-8")
+                    elif not fmt and "text/plain" in accept:
+                        # a legacy text/plain Prometheus scraper: same
+                        # families/buckets, exemplars omitted — the
+                        # 0.0.4 parser rejects the '#' exemplar marker
+                        # after a sample value
+                        self._send(
+                            server.metrics.render_prometheus(
+                                openmetrics=False).encode(),
+                            content_type="text/plain; version=0.0.4; "
+                                         "charset=utf-8")
                     else:
                         self._send(server.metrics.snapshot())
+                elif url.path == "/debug/engine":
+                    dec = server._decoder
+                    if dec is None:
+                        return self._send(
+                            {"error": "no decode engine (start the "
+                             "server with decode_vocab / --generate)"},
+                            404)
+                    body = dec.debug_snapshot()
+                    if server.supervisor is not None:
+                        body["supervisor"] = server.supervisor.status()
+                    # the FULL per-route SLO picture (status() embeds
+                    # only the burn-rate brief — /readyz must stay
+                    # cheap, a debug read need not)
+                    body["slo"] = server.slo.snapshot()
+                    self._send(body)
                 elif url.path == "/trace":
                     q = parse_qs(url.query)
                     try:
                         limit = int(q.get("limit", ["0"])[0]) or None
+                        # presence check, not `or None`: ?since=0 is the
+                        # documented initial cursor, distinct from no
+                        # cursor at all
+                        since = (int(q["since"][0]) if "since" in q
+                                 else None)
                     except ValueError:
                         return self._send(
-                            {"error": "limit must be an integer"}, 400)
+                            {"error": "limit/since must be integers"},
+                            400)
                     if q.get("format", [""])[0] == "chrome":
                         # Perfetto / chrome://tracing loadable
                         self._send(server.tracer.chrome_trace(limit=limit))
                     else:
-                        self._send(server.tracer.snapshot(limit=limit))
+                        # ?since=<cursor> tails the ring incrementally:
+                        # pass the previous response's next_cursor
+                        self._send(server.tracer.snapshot(limit=limit,
+                                                          since=since))
                 else:
                     self._send({"error": "not found"}, 404)
 
@@ -505,6 +588,8 @@ class InferenceServer:
                     return self._send({"error": "shutting_down",
                                        "request_id": rid}, 503,
                                       request_id=rid)
+                t_route = time.monotonic()
+                slo_sample = True  # flipped off by fast-reject paths
                 try:
                     if url.path == "/admin/drain":
                         if server.supervisor is None:
@@ -571,6 +656,7 @@ class InferenceServer:
                         body["blocks_needed"] = e.blocks_needed
                         body["blocks_available"] = e.blocks_available
                     m_err.inc()
+                    slo_sample = False  # client error, ~1ms: not SLO
                     self._send(body, 413, request_id=rid)
                 except TimeoutError as e:  # incl. RequestTimeoutError and
                     # decode-scheduler timeouts (the decode is cancelled
@@ -593,6 +679,7 @@ class InferenceServer:
                                503, request_id=rid)
                 except ShuttingDownError:
                     m_err.inc()
+                    slo_sample = False
                     self._send({"error": "shutting_down",
                                 "request_id": rid}, 503, request_id=rid)
                 except AdmissionRejectedError as e:
@@ -600,6 +687,7 @@ class InferenceServer:
                     # Retry-After tells well-behaved clients how long to
                     # back off (examples/serving_load_test.py honors it)
                     m_err.inc()
+                    slo_sample = False
                     server.tracer.instant("reject", track="http", args={
                         "request_id": rid, "reason": "degraded_503"})
                     self._send(
@@ -609,7 +697,10 @@ class InferenceServer:
                         headers={"Retry-After":
                                  str(max(1, int(e.retry_after_s)))})
                 except QueueFullError as e:
+                    # incl. LoadSheddedError (the ladder's own level-1
+                    # shedding): fast rejects again
                     m_err.inc()
+                    slo_sample = False
                     server.tracer.instant("reject", track="http", args={
                         "request_id": rid, "reason": "backpressure_503"})
                     self._send({"error": f"over capacity: {e}",
@@ -624,8 +715,31 @@ class InferenceServer:
                                request_id=rid)
                 except Exception as e:  # bad payloads must not kill the server
                     m_err.inc()
+                    slo_sample = False  # 400s are client errors served
+                    # in ~1ms; sampling them would dilute the burn
+                    # signal exactly like the fast-reject 503s above
                     self._send({"error": str(e), "request_id": rid}, 400,
                                request_id=rid)
+                finally:
+                    if slo_sample and url.path in ("/predict",
+                                                   "/predict/csv",
+                                                   "/generate"):
+                        # the SLO plane's input: end-to-end route
+                        # latency of requests that were actually
+                        # SERVED (timeouts included — a 504 burned the
+                        # budget). Fast-reject 503s (shed, admission-
+                        # rejected, backpressure, shutdown) are the
+                        # LADDER'S OWN OUTPUT: observing their ~1ms
+                        # latencies would dilute the violation fraction
+                        # and let the mitigation suppress the very burn
+                        # signal that triggered it (de-escalate ->
+                        # re-burn -> flap). Excluded, recovery probes
+                        # itself: with rejects unsampled the fast
+                        # window drains, burn reads 0, the ladder steps
+                        # down and real traffic re-measures.
+                        server.slo.observe(
+                            url.path, time.monotonic() - t_route,
+                            request_id=rid)
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -284,6 +284,7 @@ _SERVING_PAGE = """<!DOCTYPE html>
 <div id="mesh" style="color:#555"></div>
 <div id="kvpool" style="color:#555"></div>
 <div id="robust" style="color:#555"></div>
+<div id="slo" style="color:#555"></div>
 <div id="trace" style="font-family:monospace;font-size:12px"></div>
 <table id="t" border="1" cellpadding="4" style="border-collapse:collapse">
 </table>
@@ -387,6 +388,22 @@ async function refresh() {
       ', degradation L' + ((g.degradation_level || {}).value || 0) +
       (c.failpoint_triggers_total ? ', ' + c.failpoint_triggers_total +
         ' failpoint trigger(s)' : '');
+  // attribution & SLO line (inference/profiler.py): rolling tokens/s
+  // and MFU estimate from the cost-attribution plane, plus the latency
+  // objective's burn rates — "why is the fleet at 31% MFU" and "is p99
+  // burning" at a glance
+  const mfu = g.device_mfu_estimate, tps = g.decode_tokens_per_sec;
+  const burnF = g.slo_burn_rate_fast, burnS = g.slo_burn_rate_slow;
+  if (mfu || tps || g.slo_objective_p99_ms)
+    document.getElementById('slo').innerText =
+      'attribution: ' + (tps ? tps.value.toFixed(1) + ' tok/s, ' : '') +
+      (mfu ? 'MFU ~' + (100 * mfu.value).toFixed(2) + '%, ' : '') +
+      (g.device_hbm_gbps ? g.device_hbm_gbps.value.toFixed(3) +
+        ' GB/s attributed' : '') +
+      (g.slo_objective_p99_ms ? ' | SLO p99<=' +
+        g.slo_objective_p99_ms.value + 'ms, burn fast ' +
+        (burnF ? burnF.value.toFixed(2) : '0') + 'x / slow ' +
+        (burnS ? burnS.value.toFixed(2) : '0') + 'x' : '');
   let rows = '<tr><th>metric</th><th>value</th></tr>';
   for (const [k, v] of Object.entries(m.counters || {}))
     rows += '<tr><td>' + k + '</td><td>' + v + '</td></tr>';

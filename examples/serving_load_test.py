@@ -9,7 +9,10 @@ pre-batching serving path) and print the speedup.
 
 `--generate` drives the continuous-batching decode scheduler instead
 (`POST /generate` on a small transformer LM): each response's per-phase
-``timings`` breakdown is printed as a waterfall line, and `--trace-out
+``timings`` breakdown is printed as a waterfall line, the run ends with
+a CLIENT-side p50/p95/p99 + phase-breakdown table (ISSUE 11: the
+independent cross-check for the server's SLO monitor — the two measure
+the same requests at opposite ends of the socket), and `--trace-out
 FILE` dumps the server's flight recorder as Chrome trace-event JSON —
 open it at https://ui.perfetto.dev to see one track per decode slot
 (interleaved prefill chunks) and one per request (queued/prefill/decode).
@@ -126,6 +129,51 @@ def _post(port, path, body, retries=None):
             raise RuntimeError(
                 f"{path}: gave up after {_MAX_RETRIES} retries")
         time.sleep(delay)
+
+
+def summarize_timings(results):
+    """Client-side SLO aggregation over the per-response ``timings``
+    every `/generate` answer carries (ISSUE 11 satellite): end-to-end
+    p50/p95/p99 plus a per-phase breakdown (queue/restore/prefill/
+    decode, mean and p99 each) computed from what the CLIENT observed —
+    the independent cross-check for the server's own SLO monitor
+    (`GET /metrics` `slo_route_p99_ms`, `/debug/engine`): the two are
+    measured at different ends of the socket, so they must broadly
+    agree, and a divergence localizes the gap to the HTTP layer."""
+    timings = [r["timings"] for r in results if r.get("timings")]
+    if not timings:
+        return None
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    totals = [t["total_ms"] for t in timings]
+    out = {"n": len(timings),
+           "total_ms": {"p50": round(pct(totals, 0.50), 3),
+                        "p95": round(pct(totals, 0.95), 3),
+                        "p99": round(pct(totals, 0.99), 3)},
+           "phases": {}}
+    for ph in ("queue_ms", "restore_ms", "prefill_ms", "decode_ms"):
+        vals = [t.get(ph, 0.0) for t in timings]
+        out["phases"][ph] = {
+            "mean": round(sum(vals) / len(vals), 3),
+            "p99": round(pct(vals, 0.99), 3),
+            "share": round(sum(vals) / max(1e-9, sum(totals)), 4)}
+    return out
+
+
+def print_timing_table(summary):
+    """The end-of-run client-side latency table."""
+    if not summary:
+        return
+    t = summary["total_ms"]
+    print(f"client SLO: n={summary['n']}  total p50 {t['p50']:.1f}ms  "
+          f"p95 {t['p95']:.1f}ms  p99 {t['p99']:.1f}ms")
+    print("  phase      mean_ms    p99_ms   share")
+    for ph, s in summary["phases"].items():
+        print(f"  {ph:<10} {s['mean']:8.1f} {s['p99']:9.1f}   "
+              f"{100 * s['share']:5.1f}%")
 
 
 def _drive(server, n_threads, reqs_each, body):
@@ -248,6 +296,9 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
                   f"queue {t['queue_ms']:.1f} + restore {t['restore_ms']:.1f}"
                   f" + prefill {t['prefill_ms']:.1f} + decode "
                   f"{t['decode_ms']:.1f}")
+        # client-side percentile + phase table (cross-check against the
+        # server's SLO monitor: GET /metrics slo_route_p99_ms)
+        print_timing_table(summarize_timings(results))
         if trace_out:
             n = len(trace.get("traceEvents", []))
             print(f"trace:      {n} events -> {trace_out} "

@@ -115,3 +115,22 @@ def test_sharded_decode_healthy_row_passes():
                                "outputs_identical": 1,
                                "resharding_collectives": 0}}
     assert bench.check_floors(rows) == []
+
+
+def test_profiler_overhead_regression_is_caught():
+    """ISSUE 11 acceptance floor: the step-phase profiler + SLO monitor
+    stay armed in production, so the armed engine's mean step time
+    sliding below 95% of the disarmed one's (someone adds a lock, an
+    allocation, or a device sync to a lap/count stamp) must trip the
+    gate — as must the field going missing."""
+    regs = bench.check_floors(
+        {"profiler_overhead": {"step_time_ratio": 0.9}})
+    assert any("step_time_ratio=0.9 < floor" in r for r in regs), regs
+    regs = bench.check_floors(
+        {"profiler_overhead": {"wall_throughput_ratio": 1.0}})
+    assert any("missing/non-numeric" in r for r in regs), regs
+
+
+def test_profiler_overhead_healthy_row_passes():
+    rows = {"profiler_overhead": {"step_time_ratio": 0.979}}
+    assert bench.check_floors(rows) == []
