@@ -754,6 +754,185 @@ def bench_trace_overhead(prompt_len=64, new_tokens=24, chunk=32, vocab=64,
     }
 
 
+def bench_trace_aggregation(prompt_len=48, new_tokens=16, chunk=16,
+                            vocab=32, n_reqs=6, rounds=6,
+                            d_model=128) -> dict:
+    """Fleet-telemetry aggregation cost + completeness A/B (ISSUE 12
+    acceptance: scraping must not perturb the engines, and the merge
+    must be lossless when no ring wraps). TWO live engine servers take
+    the same closed-loop /generate load; `trace_aggregation` rounds run
+    with a `serving.telemetry` aggregator + metrics federation tailing
+    both replicas at 1 Hz — the realistic fleet cadence (the UI polls
+    at 2 s, Prometheus scrapes at 15 s+), and on a single-core host
+    the cadence IS the overhead knob — exercising the /trace?since
+    cursor, /trace/clock handshake, and /metrics?format=prometheus
+    scrape, interleaved order-alternating with unscraped rounds. The floor metric is each
+    replica's own mean scheduler step time (decode_step_time_sec,
+    race_audit's protocol — the <=5% budget is a claim about the decode
+    hot loop, not end-to-end wall time); completeness is
+    events_merged / events_emitted over the whole run, which must be
+    exactly 1 with the default 8192-event rings. Standalone-runnable:
+        python -c "import bench, json; print(json.dumps(bench.bench_trace_aggregation()))"
+    """
+    import threading
+
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.serving import InferenceServer
+    from deeplearning4j_tpu.serving.telemetry import (FleetMetrics,
+                                                      TraceAggregator)
+
+    # d128 like race_audit (not the d64 toy): the scraper's per-tick
+    # cost is FIXED, so judging a <=5% budget against a ~2ms toy step
+    # would measure the toy, not the aggregator; d128 puts the step in
+    # the realistic-model regime the budget is actually about
+    conf = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = prompt_len + new_tokens
+    net = ComputationGraph(conf).init()
+    servers = [InferenceServer(net=net, decode_vocab=vocab,
+                               decode_slots=4, prefill_chunk=chunk,
+                               slo_p99_ms=500.0).start()
+               for _ in range(2)]
+    targets = [f"http://127.0.0.1:{s.port}" for s in servers]
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, vocab, prompt_len).tolist()
+               for _ in range(n_reqs)]
+
+    import urllib.request
+
+    def post(port, prompt, toks):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": prompt,
+                             "max_new_tokens": toks}).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req).read())
+
+    def run_round(batches=2):
+        # closed-loop: one client thread per (replica, prompt) pair,
+        # `batches` sequential waves so a round lasts a few seconds —
+        # long enough that the 1 Hz scrape cadence is measured at its
+        # steady state, not dominated by thread-start edge effects
+        for _ in range(batches):
+            threads = [threading.Thread(target=post,
+                                        args=(s.port, p, new_tokens))
+                       for s in servers for p in prompts]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+    def step_state(srv):
+        s = srv.metrics.histogram("decode_step_time_sec").snapshot()
+        return (s.get("count", 0), s.get("sum", 0.0))
+
+    agg = TraceAggregator(targets)
+    fleet = FleetMetrics(targets)
+    scrape_stop = threading.Event()
+
+    def scraper():
+        # 1 Hz: the realistic fleet cadence (the UI polls /serving at
+        # 2 s, Prometheus scrapes at 15 s+; the trace tail is
+        # incremental, so 1 Hz loses nothing while the ring is not
+        # wrapping). On a single-core host every scraper millisecond
+        # comes straight out of the engines, so the cadence IS the
+        # overhead knob the floor gates.
+        while not scrape_stop.is_set():
+            scrape_stop.wait(1.0)  # wait FIRST: a tick burst at
+            # thread start would bill round-boundary edge cost to the
+            # steady-state cadence being measured
+            agg.poll()
+            fleet.scrape()
+
+    try:
+        for s in servers:  # warm every program family off the clock
+            for p in prompts:
+                post(s.port, p, 2)
+        agg.sync_clocks()
+        agg.poll()  # drain the warm-phase backlog off the clock: the
+        # first timed poll must pay for ITS round's events, not the
+        # accumulated history
+        fleet.scrape()
+        base = [step_state(s) for s in servers]
+        plain_n = [0] * 2
+        plain_s = [0.0] * 2
+        scraped_n = [0] * 2
+        scraped_s = [0.0] * 2
+        def timed_round(scraped, acc_n, acc_s):
+            agg.poll()  # drain the previous round's backlog OFF the
+            # clock: a scraped round must pay for tailing ITS OWN
+            # events, not accumulated history
+            th = None
+            if scraped:
+                scrape_stop.clear()
+                th = threading.Thread(target=scraper)
+                th.start()
+            pre = [step_state(s) for s in servers]
+            run_round()
+            if th is not None:
+                scrape_stop.set()
+                th.join()
+            for i, s in enumerate(servers):
+                n, tot = step_state(s)
+                acc_n[i] += n - pre[i][0]
+                acc_s[i] += tot - pre[i][1]
+
+        for r in range(rounds):  # interleaved A/B, ORDER ALTERNATING
+            # per round: host drift (warming caches, governor) biases
+            # whichever side always runs second, and this A/B's signal
+            # is small enough that the bias would dominate it
+            first_scraped = bool(r % 2)
+            timed_round(first_scraped, *((scraped_n, scraped_s)
+                                         if first_scraped
+                                         else (plain_n, plain_s)))
+            timed_round(not first_scraped, *((scraped_n, scraped_s)
+                                             if not first_scraped
+                                             else (plain_n, plain_s)))
+        # final quiesced tail: everything the engines emitted must be
+        # in the merge (8192-slot rings never wrapped at this load)
+        agg.poll()
+        fleet.scrape()
+        stats = agg.stats()
+        fed = fleet.summary()
+    finally:
+        for s in servers:
+            s.stop()
+    ratios = [(plain_s[i] / max(1, plain_n[i]))
+              / max(1e-12, scraped_s[i] / max(1, scraped_n[i]))
+              for i in range(2)]
+    return {
+        "step_ms_unscraped": [round(1e3 * plain_s[i] / max(1, plain_n[i]),
+                                    4) for i in range(2)],
+        "step_ms_scraped": [round(1e3 * scraped_s[i] / max(1, scraped_n[i]),
+                                  4) for i in range(2)],
+        # the FLOOR takes the worst replica: scraping must not perturb
+        # EITHER engine's hot loop
+        "step_time_ratio": round(min(ratios), 4),
+        "step_time_ratio_per_replica": [round(r, 4) for r in ratios],
+        "events_merged": stats["events_merged"],
+        "events_emitted": stats["events_emitted"],
+        "merge_completeness": stats["completeness"],
+        "fleet_replicas_up": fed["replicas_up"],
+        "fleet_p99_ms": (fed["routes"].get("/generate") or {}).get(
+            "p99_ms"),
+        "note": f"2 engine servers x {n_reqs} concurrent "
+                f"{prompt_len}-token prompts x {new_tokens} greedy "
+                f"tokens on a 2-block d{d_model} LM; scraped rounds "
+                "have a 1 Hz aggregator (the realistic fleet cadence) "
+                "tailing /trace?since + federating /metrics on both "
+                "replicas, order-alternating interleave pooled over "
+                f"{rounds} round pairs. Floors: per-replica "
+                "step_time_ratio (unscraped/scraped mean scheduler "
+                "step, worst replica) >= 0.95, and merge_completeness "
+                "(events_merged/events_emitted) = 1 when no ring "
+                "wraps",
+    }
+
+
 def bench_profiler_overhead(prompt_len=64, new_tokens=24, chunk=32,
                             vocab=64, n_reqs=6, rounds=8,
                             d_model=128) -> dict:
@@ -1804,6 +1983,12 @@ def main() -> None:
         WORKLOADS["profiler_overhead"] = bench_profiler_overhead()
     except Exception as e:
         WORKLOADS["profiler_overhead"] = {"error": str(e)}
+
+    # ---- serving: fleet-telemetry aggregation A/B (ISSUE 12) ------------
+    try:
+        WORKLOADS["trace_aggregation"] = bench_trace_aggregation()
+    except Exception as e:
+        WORKLOADS["trace_aggregation"] = {"error": str(e)}
 
     # ---- analysis: race-checker disarmed-shim-cost A/B (ISSUE 8) --------
     try:

@@ -21,6 +21,9 @@ Usage:
                    [--no-supervise] [--hang-timeout S] [--retry-budget N]
                    [--slo-p99-ms MS] [--no-profiler]
                    [--failpoint NAME=SPEC ...] [--failpoint-endpoint]
+  dl4j-tpu telemetry --targets http://h:p,http://h:p [--out trace.json]
+                   [--serve-port P] [--interval S] [--duration S]
+                   [--ui URL]
 """
 from __future__ import annotations
 
@@ -246,6 +249,26 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_telemetry(args) -> int:
+    """Fleet telemetry plane (serving/telemetry.py): tail N replicas'
+    flight recorders into one merged Perfetto waterfall and federate
+    their /metrics into one fleet exposition."""
+    from ..serving import telemetry
+
+    argv = ["--targets", args.targets,
+            "--interval", str(args.interval),
+            "--clock-probes", str(args.clock_probes)]
+    if args.out:
+        argv += ["--out", args.out]
+    if args.serve_port is not None:
+        argv += ["--serve-port", str(args.serve_port)]
+    if args.duration is not None:
+        argv += ["--duration", str(args.duration)]
+    if args.ui:
+        argv += ["--ui", args.ui]
+    return telemetry.main(argv)
+
+
 def _add_data_args(p: argparse.ArgumentParser):
     p.add_argument("--input", required=True, help="input CSV path")
     p.add_argument("--batch", type=int, default=32)
@@ -393,6 +416,28 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--once", action="store_true",
                    help="start and immediately stop (smoke test)")
     s.set_defaults(func=cmd_serve)
+
+    f = sub.add_parser("telemetry",
+                       help="fleet telemetry: merge N replicas' traces "
+                            "into one Perfetto waterfall and federate "
+                            "their metrics/SLO")
+    f.add_argument("--targets", required=True,
+                   help="comma-separated replica base URLs")
+    f.add_argument("--out", default=None,
+                   help="write the merged Perfetto trace here at exit")
+    f.add_argument("--serve-port", type=int, default=None,
+                   help="expose GET /fleet, /fleet/summary, "
+                        "/fleet/trace")
+    f.add_argument("--interval", type=float, default=1.0,
+                   help="poll/scrape cadence, seconds")
+    f.add_argument("--duration", type=float, default=None,
+                   help="run this long then exit")
+    f.add_argument("--clock-probes", type=int, default=5,
+                   help="RTT-bounded /trace/clock probes per replica")
+    f.add_argument("--ui", default=None,
+                   help="training-UI base URL for the /serving fleet "
+                        "line")
+    f.set_defaults(func=cmd_telemetry)
     return parser
 
 

@@ -157,6 +157,93 @@ def _log_buckets(lo: float, hi: float, per_decade: int) -> List[float]:
     return [lo * 10 ** (i / per_decade) for i in range(n + 1)]
 
 
+def estimate_quantile(bounds: List[float], counts: List[int], total: int,
+                      vmin: float, vmax: float, q: float) -> float:
+    """Quantile estimate over fixed-bucket counts (``counts`` has one
+    overflow slot beyond ``bounds``): walk to the owning bucket,
+    interpolate linearly inside it, clamp to [vmin, vmax] — the same
+    estimate ``histogram_quantile()`` computes server-side. Module-level
+    so the fleet federation path (`serving/telemetry.py`) can recompute
+    p50/p95/p99 from MERGED bucket counts with the exact algorithm the
+    per-replica `Histogram` uses (pass ``vmin=0, vmax=math.inf`` when
+    the extremes are unknown, e.g. parsed from a Prometheus scrape)."""
+    if not total:
+        return 0.0
+    target = q * total
+    seen = 0
+    for i, c in enumerate(counts):
+        if seen + c >= target and c:
+            lo = bounds[i - 1] if i else 0.0
+            hi = bounds[i] if i < len(bounds) else \
+                (vmax if math.isfinite(vmax) else bounds[-1])
+            frac = (target - seen) / c
+            est = lo + (hi - lo) * frac
+            return min(max(est, vmin), vmax)
+        seen += c
+    return vmax if math.isfinite(vmax) else bounds[-1]
+
+
+def merge_histograms(snapshots: List[dict]) -> dict:
+    """Merge N :meth:`Histogram.bucket_snapshot` dicts into one — the
+    fleet-federation primitive (ISSUE 12): per-bucket counts sum, count
+    and sum add, min/max recombine as min-of-mins / max-of-maxes, and
+    p50/p95/p99 are re-estimated over the merged buckets. Merging two
+    snapshots is EXACTLY equivalent to one histogram having observed
+    the union stream (property-tested in tests/test_telemetry.py),
+    because fixed canonical bucket boundaries make the bucket counts a
+    sufficient statistic.
+
+    Mismatched bucket boundaries raise ``ValueError`` — silently
+    summing bucket i of two different layouts would fabricate a
+    latency distribution, which is strictly worse than failing the
+    scrape."""
+    snaps = [s for s in snapshots if s is not None]
+    if not snaps:
+        return {"count": 0}
+    bounds = list(snaps[0]["bounds"])
+    for s in snaps[1:]:
+        b = s["bounds"]
+        if len(b) != len(bounds) or any(
+                not math.isclose(x, y, rel_tol=1e-9)
+                for x, y in zip(b, bounds)):
+            raise ValueError(
+                "cannot merge histograms with mismatched bucket "
+                f"boundaries ({len(bounds)} bounds starting "
+                f"{bounds[:2]} vs {len(b)} starting {list(b)[:2]}): "
+                "summing unlike buckets would silently fabricate the "
+                "distribution")
+        if len(s["counts"]) != len(bounds) + 1:
+            raise ValueError(
+                f"histogram counts length {len(s['counts'])} != "
+                f"bounds+overflow {len(bounds) + 1}")
+    counts = [0] * (len(bounds) + 1)
+    count, total = 0, 0.0
+    vmin, vmax = math.inf, -math.inf
+    for s in snaps:
+        for i, c in enumerate(s["counts"]):
+            counts[i] += int(c)
+        count += int(s.get("count", sum(s["counts"])))
+        total += float(s.get("sum", 0.0))
+        vmin = min(vmin, s.get("min", math.inf))
+        vmax = max(vmax, s.get("max", -math.inf))
+    if not count:
+        return {"bounds": bounds, "counts": counts, "count": 0,
+                "sum": 0.0}
+    if not math.isfinite(vmin):
+        vmin = 0.0  # extremes unknown (e.g. parsed from a Prometheus
+        # scrape, which carries no _min/_max): estimate clamps fall
+        # back to the bucket edges
+    if vmax == -math.inf:
+        vmax = math.inf
+    return {
+        "bounds": bounds, "counts": counts, "count": count,
+        "sum": round(total, 9), "min": vmin, "max": vmax,
+        "p50": estimate_quantile(bounds, counts, count, vmin, vmax, .50),
+        "p95": estimate_quantile(bounds, counts, count, vmin, vmax, .95),
+        "p99": estimate_quantile(bounds, counts, count, vmin, vmax, .99),
+    }
+
+
 class Histogram:
     """Streaming histogram over fixed log-spaced buckets.
 
@@ -247,20 +334,21 @@ class Histogram:
     def _estimate(self, counts: List[int], total: int, vmin: float,
                   vmax: float, q: float) -> float:
         """Quantile over a consistent state copy: walk to the owning
-        bucket, interpolate linearly inside it, clamp to min/max."""
-        if not total:
-            return 0.0
-        target = q * total
-        seen = 0
-        for i, c in enumerate(counts):
-            if seen + c >= target and c:
-                lo = self._bounds[i - 1] if i else 0.0
-                hi = self._bounds[i] if i < len(self._bounds) else vmax
-                frac = (target - seen) / c
-                est = lo + (hi - lo) * frac
-                return min(max(est, vmin), vmax)
-            seen += c
-        return vmax
+        bucket, interpolate linearly inside it, clamp to min/max (the
+        shared :func:`estimate_quantile`, so per-replica and merged
+        fleet estimates use one algorithm)."""
+        return estimate_quantile(self._bounds, counts, total, vmin,
+                                 vmax, q)
+
+    def bucket_snapshot(self) -> dict:
+        """Merge-ready state (:func:`merge_histograms` input): bounds,
+        NON-cumulative per-bucket counts (incl. the overflow slot),
+        count/sum/min/max — one consistent locked copy."""
+        counts, count, total, vmin, vmax = self._state()
+        return {"bounds": list(self._bounds), "counts": counts,
+                "count": count, "sum": total,
+                "min": vmin if count else math.inf,
+                "max": vmax if count else -math.inf}
 
     def percentile(self, q: float) -> float:
         """Estimated q-quantile (q in [0, 1])."""

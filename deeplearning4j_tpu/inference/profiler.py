@@ -60,7 +60,22 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .metrics import MetricsRegistry, default_registry
 
 __all__ = ["StepPhaseProfiler", "SLOMonitor", "program_costs",
-           "device_peak_flops"]
+           "device_peak_flops", "burn_verdict"]
+
+
+def burn_verdict(fast: float, slow: float, fast_burn: float = 6.0,
+                 slow_burn: float = 3.0) -> Tuple[bool, bool]:
+    """(burning, calm) from a (fast, slow) burn-rate pair — THE single
+    home of the multiwindow thresholds: burning = both windows over
+    their burn thresholds (a fast-only spike or a slow-window leftover
+    stays quiet); calm = fast window inside budget (< 1.0), the much
+    stricter de-escalation gate, so escalate/de-escalate use hysteresis
+    instead of one shared edge. Module-level so the fleet federation
+    (`serving/telemetry.py`) applies the SAME verdict to fleet-level
+    burn rates that each replica's :class:`SLOMonitor` applies locally
+    — the router's SLO-aware admission must not disagree with the
+    replicas about what "burning" means."""
+    return fast >= fast_burn and slow >= slow_burn, fast < 1.0
 
 # iteration phases, in stamp order (engine._step_once lap boundaries)
 PHASES = ("admit", "prefill", "draft", "pool", "decode", "accept",
@@ -621,12 +636,9 @@ class SLOMonitor:
 
     def _verdict(self, fast: float, slow: float) -> Tuple[bool, bool]:
         """(burning, calm) from an already-computed burn-rate pair —
-        THE single home of both thresholds (burning = both windows over
-        their burn thresholds; calm = fast window inside budget, the
-        much stricter de-escalation gate, so the ladder cannot flap on
-        one shared edge)."""
-        return (fast >= self.fast_burn and slow >= self.slow_burn,
-                fast < 1.0)
+        delegates to the module-level :func:`burn_verdict` (shared with
+        the fleet federation) at this monitor's thresholds."""
+        return burn_verdict(fast, slow, self.fast_burn, self.slow_burn)
 
     def pressure(self, now: Optional[float] = None) -> Tuple[bool, bool]:
         """(burning, calm) from ONE burn-rate computation — the ladder

@@ -83,6 +83,17 @@ Exports:
   - ``python -m deeplearning4j_tpu.inference.trace dump --url ...``
                               fetches a serving server's Chrome trace to
                               a file for Perfetto's "Open trace file"
+
+Cross-process context (`serving/telemetry.py`, ISSUE 12): records carry
+optional ``parent``/``origin`` fields — ``origin`` is a flow-edge id (a
+hop's sender span id, derived from the fleet-wide ``X-Graft-Trace``
+identity), ``parent`` the upstream process's span id, present only on
+the receiving side. The Chrome export turns them into flow events
+(``s`` at the originating span, ``f`` at each downstream span), so a
+trace merged from several processes draws one arrowed waterfall per
+request; :meth:`FlightRecorder.clock` is the monotonic-epoch + wall
+handshake the fleet aggregator uses to put N processes' timestamps on
+one axis.
 """
 from __future__ import annotations
 
@@ -90,12 +101,15 @@ import itertools
 import json
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-__all__ = ["FlightRecorder", "default_recorder", "new_request_id"]
+__all__ = ["FlightRecorder", "default_recorder", "new_request_id",
+           "render_chrome_events"]
 
-# record tuple layout (kept positional: one tuple alloc per append)
-_SEQ, _TS, _PH, _NAME, _TRACK, _ARGS = range(6)
+# record tuple layout (kept positional: one tuple alloc per append);
+# _PARENT/_ORIGIN are the cross-process trace-context fields (ISSUE 12),
+# None for every purely-local record
+_SEQ, _TS, _PH, _NAME, _TRACK, _ARGS, _PARENT, _ORIGIN = range(8)
 
 _rid_counter = itertools.count(1)
 
@@ -139,7 +153,8 @@ class FlightRecorder:
     # -- hot path ----------------------------------------------------------
     def _append(self, ph: str, name: str, req: Optional[str],
                 slot: Optional[int], track: Optional[str],
-                args: Optional[dict]) -> None:
+                args: Optional[dict], parent: Optional[str] = None,
+                origin: Optional[str] = None) -> None:
         if track is None:
             if slot is not None:
                 track = f"slot {slot}"
@@ -149,14 +164,24 @@ class FlightRecorder:
                 track = "scheduler"
         seq = next(self._seq)  # atomic claim; no lock
         self._buf[seq % self.capacity] = (
-            seq, time.monotonic(), ph, name, track, args)
+            seq, time.monotonic(), ph, name, track, args, parent, origin)
 
     def begin(self, name: str, req: Optional[str] = None,
               slot: Optional[int] = None, track: Optional[str] = None,
-              args: Optional[dict] = None) -> None:
-        """Open a span on the resolved track (close with :meth:`end`)."""
+              args: Optional[dict] = None, parent: Optional[str] = None,
+              origin: Optional[str] = None) -> None:
+        """Open a span on the resolved track (close with :meth:`end`).
+
+        ``origin``: the flow-edge id this span belongs to (a hop's
+        sender span id, derived from the fleet-wide ``X-Graft-Trace``
+        identity) — the Chrome export emits a flow event binding the
+        span into the cross-process request chain. ``parent``: the
+        upstream process's span id; set (alongside ``origin``) on the
+        RECEIVING side of a hop, absent on the originating side, so
+        the export knows which side is the arrow's tail (``s``) and
+        which the head (``f``)."""
         if self.enabled:
-            self._append("B", name, req, slot, track, args)
+            self._append("B", name, req, slot, track, args, parent, origin)
 
     def end(self, name: str, req: Optional[str] = None,
             slot: Optional[int] = None, track: Optional[str] = None,
@@ -170,28 +195,50 @@ class FlightRecorder:
         if self.enabled:
             self._append("i", name, req, slot, track, args)
 
+    def clock(self) -> dict:
+        """Monotonic-epoch + wall handshake pair (``GET /trace/clock``):
+        event ``ts`` values are seconds since this recorder's monotonic
+        ``trace_t0``, so an aggregator that reads (monotonic, wall,
+        trace_t0) in one response — and brackets the request with its
+        OWN wall clock for an RTT bound — can place every event of this
+        process on its local wall axis to within ±RTT/2."""
+        return {"monotonic": time.monotonic(), "wall": time.time(),
+                "trace_t0": self._t0}
+
     # -- read side ---------------------------------------------------------
-    def events(self, limit: Optional[int] = None) -> List[dict]:
-        """The surviving records, oldest first, as JSON-able dicts.
-        ``limit`` keeps only the newest N. Reading is lock-free too: one
-        list copy, then sort — records written while copying either make
-        it in whole or not at all (item assignment is atomic), never
-        torn. Sorted by TIMESTAMP (seq breaks ties): seq claim and
+    def _records(self) -> List[tuple]:
+        """Surviving raw records, ts-ordered (seq breaks ties): one
+        lock-free list copy, then sort — records written while copying
+        either make it in whole or not at all (item assignment is
+        atomic), never torn. Sorted by TIMESTAMP: seq claim and
         `time.monotonic()` stamp are two steps, so a preempted writer
         can hold an older seq with a newer ts — ts order is the true
         temporal order the exports guarantee per track."""
         recs = [r for r in list(self._buf) if r is not None]
         recs.sort(key=lambda r: (r[_TS], r[_SEQ]))
-        if limit is not None and limit > 0:
-            recs = recs[-limit:]
+        return recs
+
+    def _to_dicts(self, recs: List[tuple]) -> List[dict]:
         out = []
         for r in recs:
             e = {"seq": r[_SEQ], "ts": round(r[_TS] - self._t0, 6),
                  "ph": r[_PH], "name": r[_NAME], "track": r[_TRACK]}
             if r[_ARGS]:
                 e["args"] = r[_ARGS]
+            if r[_PARENT]:
+                e["parent"] = r[_PARENT]
+            if r[_ORIGIN]:
+                e["origin"] = r[_ORIGIN]
             out.append(e)
         return out
+
+    def events(self, limit: Optional[int] = None) -> List[dict]:
+        """The surviving records, oldest first, as JSON-able dicts.
+        ``limit`` keeps only the newest N."""
+        recs = self._records()
+        if limit is not None and limit > 0:
+            recs = recs[-limit:]
+        return self._to_dicts(recs)
 
     def snapshot(self, limit: Optional[int] = None,
                  since: Optional[int] = None) -> dict:
@@ -214,30 +261,37 @@ class FlightRecorder:
         ``next_cursor`` past the in-flight seq and the tail never
         delivers it (the same class of loss as ring overwrite — the
         recorder trades completeness for its zero-lock hot path, and a
-        full re-download shows the record)."""
-        evs = self.events()
-        total = (max(e["seq"] for e in evs) + 1) if evs else 0
+        full re-download shows the record).
+
+        Cursor tails really are O(new events): records behind the
+        cursor are dropped at the raw-tuple stage, BEFORE any dict
+        building — a 20 Hz fleet poller against a full 8192-slot ring
+        pays for what changed, not the whole buffer (the regression
+        `bench.py trace_aggregation` floor-gates: scraping must not
+        perturb the engines)."""
+        recs = self._records()
+        total = (max(r[_SEQ] for r in recs) + 1) if recs else 0
         cursor = total
         if since is not None and since >= 0:
             # since=0 is the documented INITIAL cursor and must take
             # this branch: falling through to the legacy newest-N limit
             # semantics would silently skip the oldest events on the
             # very first page of a tail
-            evs = [e for e in evs if e["seq"] >= since]
-            if limit is not None and 0 < limit < len(evs):
+            recs = [r for r in recs if r[_SEQ] >= since]
+            if limit is not None and 0 < limit < len(recs):
                 # cursor mode pages FORWARD: keep the OLDEST N so the
                 # next poll's since resumes exactly after the last
                 # returned event — keeping the newest N here (the
                 # legacy limit semantics) would silently skip the
                 # middle of a burst and next_cursor would paper over it
-                evs = evs[:limit]
-                cursor = max(e["seq"] for e in evs) + 1
+                recs = recs[:limit]
+                cursor = max(r[_SEQ] for r in recs) + 1
         elif limit is not None and limit > 0:
-            evs = evs[-limit:]
+            recs = recs[-limit:]
         return {"capacity": self.capacity, "total_recorded": total,
                 "dropped": max(0, total - self.capacity),
                 "next_cursor": cursor,
-                "events": evs}
+                "events": self._to_dicts(recs)}
 
     def export(self, since: Optional[int] = None,
                limit: Optional[int] = None) -> dict:
@@ -265,7 +319,10 @@ class FlightRecorder:
         an ``E`` whose ``B`` was overwritten is dropped, a ``B`` whose
         ``E`` is missing (still open, or overwritten) is closed at the
         last exported timestamp — so every emitted ``B`` has a matching
-        ``E``, properly nested per track, with monotonic ``ts``."""
+        ``E``, properly nested per track, with monotonic ``ts``. Spans
+        carrying cross-process context (``origin``) additionally emit a
+        flow event, so a merged multi-process trace draws one arrow
+        chain per request."""
         evs = self.events(limit)
         tids: Dict[str, tuple] = {}
         counters = {0: 0, 1: 0, 2: 0}
@@ -279,45 +336,7 @@ class FlightRecorder:
             return tids[track]
 
         out: List[dict] = []
-        stacks: Dict[tuple, List[dict]] = {}
-        last_ts = 0.0
-
-        def emit(ph: str, name: str, ts: float, pid: int, tid: int,
-                 args: Optional[dict]) -> dict:
-            e = {"name": name, "ph": ph, "ts": round(ts * 1e6, 1),
-                 "pid": pid, "tid": tid}
-            if ph == "i":
-                e["s"] = "t"  # thread-scoped instant
-            if args:
-                e["args"] = args
-            out.append(e)
-            return e
-
-        for ev in evs:
-            pid, tid = tid_of(ev["track"])
-            ts = ev["ts"]
-            last_ts = max(last_ts, ts)
-            args = ev.get("args")
-            if ev["ph"] == "B":
-                stacks.setdefault((pid, tid), []).append(
-                    emit("B", ev["name"], ts, pid, tid, args))
-            elif ev["ph"] == "E":
-                stack = stacks.get((pid, tid), [])
-                if not any(b["name"] == ev["name"] for b in stack):
-                    continue  # orphan end: its begin was overwritten
-                # close intervening opens first (their end was lost to
-                # the ring, or the writer died mid-span) to keep nesting
-                while stack and stack[-1]["name"] != ev["name"]:
-                    inner = stack.pop()
-                    emit("E", inner["name"], ts, pid, tid, None)
-                stack.pop()
-                emit("E", ev["name"], ts, pid, tid, args)
-            else:
-                emit("i", ev["name"], ts, pid, tid, args)
-        for (pid, tid), stack in stacks.items():
-            while stack:  # still-open spans close at the last timestamp
-                b = stack.pop()
-                emit("E", b["name"], last_ts, pid, tid, None)
+        render_chrome_events(evs, tid_of, out)
         meta = [{"name": "process_name", "ph": "M", "pid": p, "tid": 0,
                  "args": {"name": label}}
                 for p, label in ((0, "serving"), (1, "decode slots"),
@@ -338,6 +357,81 @@ class FlightRecorder:
                 and e.get("args", {}).get("request_id")]
         done = done[-max(1, limit):]
         return [{"outcome": e["name"], **e["args"]} for e in done]
+
+
+def render_chrome_events(evs: List[dict],
+                         tid_of: Callable[[str], Tuple[int, int]],
+                         out: List[dict]) -> float:
+    """Render ``events()``-shaped dicts into Chrome trace events on
+    ``out`` — the core shared by :meth:`FlightRecorder.chrome_trace`
+    (one process) and `serving.telemetry.TraceAggregator` (N processes
+    merged onto one axis; the caller pre-aligns ``ts`` and maps each
+    process to its own pid group via ``tid_of``).
+
+    Guarantees: every ``B`` is closed by a matching ``E`` (orphan ends
+    dropped, orphan begins closed at the last timestamp), LIFO-nested
+    and ts-monotonic per (pid, tid). Spans carrying ``origin`` (the
+    fleet-wide trace id) emit a flow event at the span's begin — phase
+    ``s`` on the originating side (no ``parent``), phase ``f`` with
+    ``bp: "e"`` (bind to enclosing slice) on each receiving side — so
+    Perfetto draws one arrow chain per propagated request.
+
+    Returns the last rendered timestamp (seconds)."""
+    stacks: Dict[tuple, List[dict]] = {}
+    last_ts = 0.0
+
+    def emit(ph: str, name: str, ts: float, pid: int, tid: int,
+             args: Optional[dict]) -> dict:
+        e = {"name": name, "ph": ph, "ts": round(ts * 1e6, 1),
+             "pid": pid, "tid": tid}
+        if ph == "i":
+            e["s"] = "t"  # thread-scoped instant
+        if args:
+            e["args"] = args
+        out.append(e)
+        return e
+
+    for ev in evs:
+        pid, tid = tid_of(ev["track"])
+        ts = ev["ts"]
+        last_ts = max(last_ts, ts)
+        args = ev.get("args")
+        if ev["ph"] == "B":
+            stacks.setdefault((pid, tid), []).append(
+                emit("B", ev["name"], ts, pid, tid, args))
+            origin = ev.get("origin")
+            if origin:
+                # flow events share the slice's (ts, pid, tid) so the
+                # binding slice is unambiguous; the id IS the fleet
+                # trace id, so sides emitted by different processes
+                # join into one flow once merged
+                flow = {"name": "graft", "cat": "graft",
+                        "id": str(origin), "ts": round(ts * 1e6, 1),
+                        "pid": pid, "tid": tid}
+                if ev.get("parent"):
+                    flow["ph"] = "f"
+                    flow["bp"] = "e"
+                else:
+                    flow["ph"] = "s"
+                out.append(flow)
+        elif ev["ph"] == "E":
+            stack = stacks.get((pid, tid), [])
+            if not any(b["name"] == ev["name"] for b in stack):
+                continue  # orphan end: its begin was overwritten
+            # close intervening opens first (their end was lost to
+            # the ring, or the writer died mid-span) to keep nesting
+            while stack and stack[-1]["name"] != ev["name"]:
+                inner = stack.pop()
+                emit("E", inner["name"], ts, pid, tid, None)
+            stack.pop()
+            emit("E", ev["name"], ts, pid, tid, args)
+        else:
+            emit("i", ev["name"], ts, pid, tid, args)
+    for (pid, tid), stack in stacks.items():
+        while stack:  # still-open spans close at the last timestamp
+            b = stack.pop()
+            emit("E", b["name"], last_ts, pid, tid, None)
+    return last_ts
 
 
 _default: Optional[FlightRecorder] = None

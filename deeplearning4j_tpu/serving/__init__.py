@@ -5,9 +5,17 @@ from .durable import (DurableLogConsumer, DurableLogProducer,
 from .server import InferenceServer
 from .streaming import (QueueDataSetIterator, RecordToDataSetConverter,
                         ServeRoute, StreamingTrainingPipeline)
+from .telemetry import (TRACE_HEADER, ClientTracer, FleetMetrics,
+                        FleetTelemetryServer, TraceAggregator,
+                        TraceContext, format_trace_header,
+                        parse_trace_header)
 
-__all__ = ["DecodeScheduler", "DurableLogConsumer", "DurableLogProducer",
-           "DurableStreamingTrainer", "InferenceServer", "MetricsRegistry",
-           "MicroBatcher", "QueueDataSetIterator", "QueueFullError",
-           "RecordToDataSetConverter", "RequestTimeoutError", "ServeRoute",
-           "StreamingTrainingPipeline"]
+__all__ = ["ClientTracer", "DecodeScheduler", "DurableLogConsumer",
+           "DurableLogProducer", "DurableStreamingTrainer",
+           "FleetMetrics", "FleetTelemetryServer", "InferenceServer",
+           "MetricsRegistry", "MicroBatcher", "QueueDataSetIterator",
+           "QueueFullError", "RecordToDataSetConverter",
+           "RequestTimeoutError", "ServeRoute",
+           "StreamingTrainingPipeline", "TRACE_HEADER",
+           "TraceAggregator", "TraceContext", "format_trace_header",
+           "parse_trace_header"]

@@ -55,6 +55,14 @@ prefill/decode, summing to the end-to-end latency), and `GET /trace`
 exports the ring — structured JSON or Chrome trace-event format
 (`?format=chrome`, Perfetto-loadable; `python -m
 deeplearning4j_tpu.inference.trace dump` fetches it to a file).
+Cross-process context (`serving/telemetry.py`): a valid
+``X-Graft-Trace`` ingress header (fleet trace id, sender span id, hop
+count, send timestamp) makes the request's spans joinable across
+processes — the handler records an ``rpc`` span carrying the flow
+edge, and the fleet aggregator merges N replicas' rings into one
+Perfetto waterfall via the `GET /trace/clock` handshake. A malformed
+header of either kind degrades to a fresh server-minted context,
+never an error.
 
 Fault tolerance (`inference/supervisor.py`, `inference/failpoints.py`):
 the decode engine runs under an EngineSupervisor by default
@@ -93,6 +101,10 @@ Endpoints:
                           acceptance, mesh, per-family FLOPs/bytes from
                           cost_analysis(), MFU/tokens-per-sec estimates,
                           step-phase decomposition, supervisor+SLO state
+  GET  /trace/clock       clock-alignment handshake (monotonic + wall +
+                          trace_t0): the fleet aggregator
+                          (serving/telemetry.py) places this process's
+                          trace timestamps on the fleet timeline
   GET  /trace             flight-recorder dump (?limit=N newest events;
                           ?since=CURSOR tails incrementally — pass the
                           previous response's next_cursor;
@@ -139,6 +151,7 @@ from ..inference import (AdmissionRejectedError, DecodeScheduler,
 from ..inference.failpoints import InjectedFault
 from ..inference.trace import FlightRecorder, new_request_id
 from .streaming import RecordToDataSetConverter
+from .telemetry import TRACE_HEADER, parse_trace_header
 
 # what a client-supplied X-Request-Id may look like before we echo it
 # back into a response HEADER: obs-folded request headers reach
@@ -529,6 +542,14 @@ class InferenceServer:
                     # cheap, a debug read need not)
                     body["slo"] = server.slo.snapshot()
                     self._send(body)
+                elif url.path == "/trace/clock":
+                    # clock-alignment handshake (serving/telemetry.py):
+                    # the fleet aggregator brackets this read with its
+                    # own wall clock to place this process's trace ts
+                    # axis on the fleet timeline to within ±RTT/2
+                    import os
+                    self._send({**server.tracer.clock(),
+                                "pid": os.getpid()})
                 elif url.path == "/trace":
                     q = parse_qs(url.query)
                     try:
@@ -564,8 +585,19 @@ class InferenceServer:
                 # trace track — stack-paired B/E spans would garble).
                 # The id rides the trace spans, the response header, and
                 # every error body — "my request was slow" becomes
-                # "request r000123 was slow", greppable in /trace
-                rid = self.headers.get("X-Request-Id") or ""
+                # "request r000123 was slow", greppable in /trace.
+                # Cross-process context (serving/telemetry.py): a valid
+                # X-Graft-Trace header WINS the identity — its fleet
+                # trace id becomes the prefix, so one request keeps one
+                # greppable identity across client -> router -> replica.
+                # Both headers are length-capped BEFORE any matching and
+                # validated against a control-character-free alphabet; a
+                # malformed value of either degrades to a fresh
+                # server-minted id — never a 500, never an unvalidated
+                # byte into trace records or exemplar labels.
+                ctx = parse_trace_header(self.headers.get(TRACE_HEADER))
+                rid = (ctx.request_id if ctx is not None
+                       else (self.headers.get("X-Request-Id") or "")[:256])
                 rid = (f"{rid}.{new_request_id()}"
                        if _REQUEST_ID_RE.fullmatch(rid)
                        else new_request_id())
@@ -590,6 +622,25 @@ class InferenceServer:
                                       request_id=rid)
                 t_route = time.monotonic()
                 slo_sample = True  # flipped off by fast-reject paths
+                if ctx is not None:
+                    # server-side half of the cross-process waterfall:
+                    # an `rpc` span on the request track wrapping the
+                    # handler (closed in the finally below, so error
+                    # paths close it too), carrying the flow edge
+                    # (origin = the sender's span id, so the merged
+                    # Chrome export draws the client->server arrow) and
+                    # the sender's send timestamp (net_gap_ms = wire +
+                    # accept-queue time between tiers,
+                    # clock-skew-bounded)
+                    server.tracer.begin(
+                        "rpc", req=rid,
+                        origin=ctx.parent or ctx.request_id,
+                        parent=ctx.parent or ctx.request_id,
+                        args={"path": url.path, "hop": ctx.hop,
+                              "trace": ctx.request_id,
+                              "net_gap_ms": round(
+                                  (time.time() - ctx.origin_ts) * 1e3,
+                                  3)})
                 try:
                     if url.path == "/admin/drain":
                         if server.supervisor is None:
@@ -721,6 +772,10 @@ class InferenceServer:
                     self._send({"error": str(e), "request_id": rid}, 400,
                                request_id=rid)
                 finally:
+                    if ctx is not None:
+                        # close the ingress rpc span: server-observed
+                        # end-to-end wall time on the request track
+                        server.tracer.end("rpc", req=rid)
                     if slo_sample and url.path in ("/predict",
                                                    "/predict/csv",
                                                    "/generate"):

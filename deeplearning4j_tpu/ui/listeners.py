@@ -207,7 +207,8 @@ def post_tsne(server_url: str, coords, labels=None,
 
 
 def post_serving_metrics(server_url: str, metrics,
-                         session_id: str = "default", tracer=None) -> None:
+                         session_id: str = "default", tracer=None,
+                         fleet=None) -> None:
     """Upload a serving SLO metrics snapshot for the /serving view.
 
     ``metrics``: an `inference.MetricsRegistry` (snapshotted here) or an
@@ -218,11 +219,22 @@ def post_serving_metrics(server_url: str, metrics,
     ``tracer``: optionally an `inference.FlightRecorder` (e.g.
     ``srv.tracer``) — its newest per-request phase timings ride along and
     render as the /serving page's trace-waterfall lines (one bar per
-    recent request: queue | restore | prefill | decode)."""
+    recent request: queue | restore | prefill | decode).
+
+    ``fleet``: optionally a `serving.telemetry.FleetMetrics.summary()`
+    dict (or the FleetMetrics itself) — renders the /serving page's
+    fleet line: replicas up, fleet p99 per route, fleet burn rates,
+    scrape errors (the telemetry CLI's ``--ui`` flag pushes this)."""
     snap = metrics.snapshot() if hasattr(metrics, "snapshot") else dict(metrics)
-    payload = {"metrics": snap}
+    # the update endpoint MERGES top-level keys, so a fleet-only pusher
+    # (the telemetry CLI passes metrics={}) must not send an empty
+    # "metrics" that would blank an engine pusher's table
+    payload = {"metrics": snap} if snap else {}
     if tracer is not None:
         payload["trace"] = tracer.request_summaries(12)
+    if fleet is not None:
+        payload["fleet"] = (fleet.summary() if hasattr(fleet, "summary")
+                            else dict(fleet))
     _post(f"{server_url.rstrip('/')}/serving/update?sid={session_id}",
           payload)
 

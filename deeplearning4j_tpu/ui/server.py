@@ -285,6 +285,7 @@ _SERVING_PAGE = """<!DOCTYPE html>
 <div id="kvpool" style="color:#555"></div>
 <div id="robust" style="color:#555"></div>
 <div id="slo" style="color:#555"></div>
+<div id="fleet" style="color:#555"></div>
 <div id="trace" style="font-family:monospace;font-size:12px"></div>
 <table id="t" border="1" cellpadding="4" style="border-collapse:collapse">
 </table>
@@ -404,6 +405,23 @@ async function refresh() {
         g.slo_objective_p99_ms.value + 'ms, burn fast ' +
         (burnF ? burnF.value.toFixed(2) : '0') + 'x / slow ' +
         (burnS ? burnS.value.toFixed(2) : '0') + 'x' : '');
+  // fleet line (serving/telemetry.py federation, pushed by the
+  // telemetry CLI's --ui flag): replicas up, fleet-level p99 per
+  // route from MERGED histogram buckets, traffic-weighted burn rates
+  const fl = d.fleet;
+  if (fl) {
+    const routes = Object.entries(fl.routes || {}).map(([r, v]) =>
+      esc(r) + ' p99 ' + v.p99_ms + 'ms').join(', ');
+    document.getElementById('fleet').innerHTML =
+      'fleet: ' + (+fl.replicas_up || 0) + '/' +
+      (+fl.replicas_total || 0) + ' replicas up' +
+      (routes ? ' | ' + routes : '') +
+      ' | burn fast ' + (+fl.burn_rate_fast || 0).toFixed(2) +
+      'x / slow ' + (+fl.burn_rate_slow || 0).toFixed(2) + 'x' +
+      (fl.burning ? ' <b style="color:#c00">BURNING</b>' : '') +
+      (fl.scrape_errors_total ? ', ' + (+fl.scrape_errors_total) +
+        ' scrape error(s)' : '');
+  }
   let rows = '<tr><th>metric</th><th>value</th></tr>';
   for (const [k, v] of Object.entries(m.counters || {}))
     rows += '<tr><td>' + k + '</td><td>' + v + '</td></tr>';
@@ -563,7 +581,13 @@ class UiServer:
                                      "labels": payload.get("labels", [])})
                     return self._json({"status": "ok"})
                 if url.path == "/serving/update":
-                    server.serving.put(sid, "latest", payload)
+                    # MERGE top-level keys (atomically, inside the
+                    # storage lock): the engine-side pusher owns
+                    # "metrics"/"trace", the fleet telemetry CLI owns
+                    # "fleet" — two independent pushers composing one
+                    # page must not clobber each other's keys (a pusher
+                    # re-sending a key it owns still replaces it)
+                    server.serving.merge(sid, "latest", payload)
                     return self._json({"status": "ok"})
                 if url.path == "/nearestneighbors/update":
                     server._nn_index(sid, payload.get("labels", []),

@@ -50,6 +50,18 @@ class SessionStorage:
         with self._lock:
             self._data[session_id][key] = value
 
+    def merge(self, session_id: str, key: str, value: dict) -> None:
+        """Merge ``value``'s top-level keys into the stored dict — ONE
+        atomic read-modify-write under the lock (two independent
+        pushers, e.g. the engine metrics poster and the fleet
+        telemetry CLI, must not lose each other's keys to a get/put
+        race), storing a NEW dict so concurrent readers keep a stable
+        snapshot."""
+        with self._lock:
+            prev = self._data[session_id].get(key)
+            base = prev if isinstance(prev, dict) else {}
+            self._data[session_id][key] = {**base, **value}
+
     def get(self, session_id: str, key: str) -> Optional[Any]:
         with self._lock:
             return self._data.get(session_id, {}).get(key)

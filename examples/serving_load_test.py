@@ -12,10 +12,14 @@ pre-batching serving path) and print the speedup.
 ``timings`` breakdown is printed as a waterfall line, the run ends with
 a CLIENT-side p50/p95/p99 + phase-breakdown table (ISSUE 11: the
 independent cross-check for the server's SLO monitor — the two measure
-the same requests at opposite ends of the socket), and `--trace-out
-FILE` dumps the server's flight recorder as Chrome trace-event JSON —
-open it at https://ui.perfetto.dev to see one track per decode slot
-(interleaved prefill chunks) and one per request (queued/prefill/decode).
+the same requests at opposite ends of the socket). Every request also
+carries a propagated ``X-Graft-Trace`` context and a client-side span
+(ISSUE 12), so `--trace-out FILE` now writes the MERGED two-process
+Chrome trace (client + server track groups, clock-aligned, one flow
+arrow per request) via `serving.telemetry.TraceAggregator` — open it
+at https://ui.perfetto.dev to read the network/queue gap between the
+tiers straight off the waterfall; the report prints the same gap as
+client-observed minus server-observed latency.
 
 Chaos-compatible (ISSUE 7): the HTTP client retries connection-refused
 and 5xx responses with capped exponential backoff and honors 503
@@ -94,17 +98,20 @@ _BACKOFF_BASE_S = 0.05
 _BACKOFF_CAP_S = 2.0
 
 
-def _post(port, path, body, retries=None):
+def _post(port, path, body, retries=None, headers=None):
     """POST with capped exponential backoff on connection-refused/5xx.
     Honors a 503's ``Retry-After`` header (the degradation ladder's
     explicit back-off hint) over the computed delay. Returns the parsed
     JSON; when a ``retries`` list is passed, the number of retries this
-    request needed is appended to it (the per-request retry record)."""
+    request needed is appended to it (the per-request retry record).
+    ``headers`` rides extra request headers (the propagated
+    ``X-Graft-Trace`` context in --generate mode)."""
     attempt = 0
     while True:
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}{path}", data=body,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
         try:
             out = json.loads(urllib.request.urlopen(req).read())
             if retries is not None:
@@ -216,7 +223,20 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
     """Drive POST /generate and show where each request's time went.
     ``mesh`` > 1: tensor-parallel decode over that many devices, paged
     KV pool (per-device budget) instead of the contiguous prefix
-    cache."""
+    cache.
+
+    Fleet telemetry (ISSUE 12): every request carries a propagated
+    ``X-Graft-Trace`` context and records a CLIENT-side span (send ->
+    first-byte -> done) into a local FlightRecorder; at the end the
+    `serving.telemetry.TraceAggregator` clock-aligns and merges the
+    client and server rings into ONE Perfetto trace (``--trace-out``
+    now writes the merged two-process waterfall, flow arrows included),
+    and the report shows client-observed vs server-observed latency —
+    the network/queue gap between the tiers."""
+    from deeplearning4j_tpu.inference.trace import FlightRecorder
+    from deeplearning4j_tpu.serving.telemetry import (ClientTracer,
+                                                      TraceAggregator)
+
     vocab = 32
     net = _make_lm(vocab, cache=prompt_len + new_tokens)
     kw = (dict(kv_pool_mb=4.0, decode_tp=mesh) if mesh and mesh > 1
@@ -225,6 +245,7 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
                           prefill_chunk=16, kv_block=8, **kw).start()
     rng = np.random.default_rng(0)
     results, errors, retry_counts = [], [], []
+    ctracer = ClientTracer(FlightRecorder(8192))
     # prompts pre-built on the main thread (numpy Generators are not
     # thread-safe); a few repeats so the prefix cache has something to hit
     bodies = [json.dumps(
@@ -238,10 +259,17 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
             # set, so each prompt is sent ~twice across the run (the
             # prefix-cache repeat mix)
             try:
-                results.append(_post(srv.port, "/generate",
-                                     bodies[(k * reqs_each + i)
-                                            % len(bodies)],
-                                     retries=retry_counts))
+                ctx = ctracer.send("/generate")
+                t_send = time.perf_counter()
+                r = _post(srv.port, "/generate",
+                          bodies[(k * reqs_each + i) % len(bodies)],
+                          retries=retry_counts,
+                          headers=ctracer.headers(ctx))
+                r["client_ms"] = (time.perf_counter() - t_send) * 1e3
+                ctracer.done(ctx, args={
+                    "request_id": r.get("request_id"),
+                    "client_ms": round(r["client_ms"], 3)})
+                results.append(r)
             except Exception as e:
                 errors.append(repr(e))
 
@@ -258,9 +286,15 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
         for t in threads:
             t.join()
         elapsed = time.perf_counter() - t0
+        # merge the client ring with the server's over HTTP — the same
+        # aggregator path a real fleet runs (clock handshake included)
+        agg = TraceAggregator([f"http://127.0.0.1:{srv.port}"],
+                              client_recorder=ctracer.recorder)
+        agg.sync_clocks()
+        agg.poll()
+        merge_stats = agg.stats()
         if trace_out:
-            trace = json.loads(urllib.request.urlopen(
-                f"http://127.0.0.1:{srv.port}/trace?format=chrome").read())
+            trace = agg.merged_chrome_trace()
             with open(trace_out, "w") as fh:
                 json.dump(trace, fh)
         tp_used = getattr(srv._decoder, "tp", 1)  # before stop() drops it
@@ -299,10 +333,25 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
         # client-side percentile + phase table (cross-check against the
         # server's SLO monitor: GET /metrics slo_route_p99_ms)
         print_timing_table(summarize_timings(results))
+        # client-observed vs server-observed latency: the difference is
+        # the HTTP/network/accept-queue gap BETWEEN the tiers — exactly
+        # what the merged waterfall's client->server flow arrow spans
+        gaps = sorted(r["client_ms"] - r["timings"]["total_ms"]
+                      for r in results
+                      if "client_ms" in r and r.get("timings"))
+        if gaps:
+            print(f"tier gap:   client-observed minus server-observed "
+                  f"latency: mean {sum(gaps) / len(gaps):.2f}ms  "
+                  f"p99 {gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))]:.2f}ms "
+                  f"(network + accept queue)")
+        print(f"merged:     {merge_stats['events_merged']} events from "
+              f"{len(merge_stats['sources'])} processes "
+              f"(completeness {merge_stats['completeness']})")
         if trace_out:
             n = len(trace.get("traceEvents", []))
-            print(f"trace:      {n} events -> {trace_out} "
-                  "(open at https://ui.perfetto.dev)")
+            print(f"trace:      {n} merged events -> {trace_out} "
+                  "(client + server waterfall; open at "
+                  "https://ui.perfetto.dev)")
     return results
 
 

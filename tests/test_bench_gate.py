@@ -134,3 +134,26 @@ def test_profiler_overhead_regression_is_caught():
 def test_profiler_overhead_healthy_row_passes():
     rows = {"profiler_overhead": {"step_time_ratio": 0.979}}
     assert bench.check_floors(rows) == []
+
+
+def test_trace_aggregation_regressions_are_caught():
+    """ISSUE 12 acceptance floors: the fleet aggregator tailing two
+    replicas must not perturb their scheduler hot loops (per-replica
+    step_time_ratio >= 0.95 — someone making /trace?since O(ring)
+    again, or a scrape path grabbing an engine lock, trips this), and
+    the merge must be lossless when no ring wraps (completeness = 1 —
+    a cursor bug silently skipping events trips this)."""
+    rows = {"trace_aggregation": {"step_time_ratio": 0.8,
+                                  "merge_completeness": 1.0}}
+    regs = bench.check_floors(rows)
+    assert any("step_time_ratio" in r for r in regs), regs
+    rows = {"trace_aggregation": {"step_time_ratio": 1.0,
+                                  "merge_completeness": 0.97}}
+    regs = bench.check_floors(rows)
+    assert any("merge_completeness" in r for r in regs), regs
+
+
+def test_trace_aggregation_healthy_row_passes():
+    rows = {"trace_aggregation": {"step_time_ratio": 0.99,
+                                  "merge_completeness": 1.0}}
+    assert bench.check_floors(rows) == []
