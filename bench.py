@@ -1263,6 +1263,164 @@ def bench_chaos_recovery(prompt_len=48, new_tokens=16, chunk=16, vocab=64,
     }
 
 
+def bench_fleet_router(n_prompts=8, prompt_len=48, new_tokens=8,
+                       n_clients=4, vocab=32) -> dict:
+    """Fleet-router A/B (ISSUE 13 acceptance): the SAME workload — a
+    cold pass over ``n_prompts`` distinct prompts, then a warm repeat
+    pass — through (a) a router fronting ONE engine replica process and
+    (b) a router fronting TWO, prefix-affinity-routed.
+
+    The gated axis is the fleet PREFIX-CACHE HIT RATE: naive balancing
+    dilutes it by N (a repeat lands on the other replica and prefills
+    cold), affinity routing keeps every repeat on the replica that
+    already holds its blocks, so the N=2 hit rate must stay at the
+    single-replica floor (``hit_rate_ratio_vs_single``). Also gated:
+    ``lost_requests`` == 0 (journal ledger: every accept terminal) and
+    token identity of every completion across fleet sizes.
+    Standalone-runnable:
+        python -c "import bench, json; print(json.dumps(bench.bench_fleet_router()))"
+    """
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.serving.replica import (ReplicaProcess,
+                                                    ReplicaSupervisor,
+                                                    lm_spec_argv)
+    from deeplearning4j_tpu.serving.router import (FleetRouter,
+                                                   ReplicaEndpoint)
+
+    wd = tempfile.mkdtemp(prefix="dl4j-bench-fleet-")
+    argv = lm_spec_argv(vocab=vocab, d_model=32, n_heads=4, n_blocks=2,
+                        cache=prompt_len + new_tokens + 16) + [
+        "--slots", "4", "--prefill-chunk", "16",
+        "--prefix-cache-mb", "16", "--kv-block", "8"]
+    rng = np.random.default_rng(3)
+    bodies = [json.dumps(
+        {"prompt": rng.integers(0, vocab, prompt_len).tolist(),
+         "max_new_tokens": new_tokens}).encode()
+        for _ in range(n_prompts)]
+
+    def counters(url):
+        m = json.loads(urllib.request.urlopen(
+            url + "/metrics", timeout=10).read())
+        return (float(m["counters"].get(
+                    "prefix_cache_hit_tokens_total", 0.0)),
+                float(m["counters"].get(
+                    "prefix_cache_lookup_tokens_total", 0.0)))
+
+    def run_workload(port):
+        """Two passes (cold then warm); returns (tokens by prompt idx,
+        latencies_ms, errors)."""
+        outs = {}
+        lats = []
+        errors = []
+
+        def client(k):
+            for i in range(k, len(bodies), n_clients):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/generate", data=bodies[i],
+                    headers={"Content-Type": "application/json"})
+                try:
+                    t0 = time.perf_counter()
+                    r = json.loads(urllib.request.urlopen(
+                        req, timeout=120).read())
+                    lats.append((time.perf_counter() - t0) * 1e3)
+                    outs[i] = r["tokens"]
+                except Exception as e:  # noqa: BLE001 - lost-request record
+                    errors.append(repr(e))
+
+        def one_pass():
+            ts = [threading.Thread(target=client, args=(k,))
+                  for k in range(n_clients)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        t0 = time.perf_counter()
+        one_pass()
+        one_pass()
+        return outs, lats, errors, time.perf_counter() - t0
+
+    # one process-owning supervisor keeps both replicas alive across
+    # both phases; phase A restricts ROUTING to r0 via an attach-mode
+    # endpoint supervisor (probe-only — no double ownership)
+    owner = ReplicaSupervisor(
+        [ReplicaProcess(argv, name=f"r{i}", workdir=wd) for i in range(2)])
+    owner.start()
+    lost = 0
+    try:
+        urls = dict(owner.ready_replicas())
+        # ---- phase A: single replica --------------------------------
+        supA = ReplicaSupervisor([ReplicaEndpoint(urls["r0"], "r0")],
+                                 poll_interval_s=0.2)
+        routerA = FleetRouter(supervisor=supA, quorum=1, kv_block=8,
+                              journal_path=os.path.join(wd, "a.journal"),
+                              scrape_interval_s=0.5).start()
+        h0, l0 = counters(urls["r0"])
+        outs_a, lats_a, errs_a, wall_a = run_workload(routerA.port)
+        h1, l1 = counters(urls["r0"])
+        ja = routerA.journal.stats()
+        routerA.stop(stop_replicas=False)
+        supA.stop(terminate=False)
+        hit_single = (h1 - h0) / max(1.0, l1 - l0)
+        lost += len(errs_a) + (ja["accepted_total"] - ja["finished_total"]
+                               - ja["failed_total"])
+        # reset the replicas' prefix tries (drain swaps a fresh engine)
+        # so phase B starts as cold as phase A did
+        owner.rolling_drain()
+        urls = dict(owner.ready_replicas())
+        # ---- phase B: 2-replica fleet, affinity-routed --------------
+        supB = ReplicaSupervisor(
+            [ReplicaEndpoint(urls[n], n) for n in sorted(urls)],
+            poll_interval_s=0.2)
+        routerB = FleetRouter(supervisor=supB, quorum=2, kv_block=8,
+                              journal_path=os.path.join(wd, "b.journal"),
+                              scrape_interval_s=0.5).start()
+        deltas = {n: counters(urls[n]) for n in urls}
+        outs_b, lats_b, errs_b, wall_b = run_workload(routerB.port)
+        hit = lookup = 0.0
+        for n in urls:
+            h2, l2 = counters(urls[n])
+            hit += h2 - deltas[n][0]
+            lookup += l2 - deltas[n][1]
+        jb = routerB.journal.stats()
+        routerB.stop(stop_replicas=False)
+        supB.stop(terminate=False)
+        hit_fleet = hit / max(1.0, lookup)
+        lost += len(errs_b) + (jb["accepted_total"] - jb["finished_total"]
+                               - jb["failed_total"])
+    finally:
+        owner.stop()
+    identical = int(outs_a == outs_b and len(outs_a) == n_prompts)
+    return {
+        "hit_rate_single": round(hit_single, 4),
+        "hit_rate_fleet": round(hit_fleet, 4),
+        "hit_rate_ratio_vs_single": round(
+            hit_fleet / max(1e-9, hit_single), 4),
+        "req_per_s_single": round(2 * n_prompts / wall_a, 2),
+        "req_per_s_fleet": round(2 * n_prompts / wall_b, 2),
+        "p99_ms_single": round(float(np.percentile(lats_a, 99)), 2),
+        "p99_ms_fleet": round(float(np.percentile(lats_b, 99)), 2),
+        "lost_requests": lost,
+        "outputs_identical": identical,
+        "journal_fleet": {k: jb[k] for k in
+                          ("accepted_total", "finished_total",
+                           "failed_total",
+                           "duplicate_finishes_suppressed")},
+        "note": f"{n_prompts} distinct {prompt_len}-token prompts x "
+                f"{new_tokens} greedy tokens, cold pass + warm repeat "
+                f"pass, {n_clients} client threads; replicas are real "
+                "subprocesses (seeded identical params); phase B routes "
+                "prefix-affine over 2 replicas — the floor pins the "
+                "fleet hit rate at the single-replica level (affinity "
+                "engaged, no dilution by N), zero lost requests "
+                "(journal ledger), outputs token-identical across "
+                "fleet sizes",
+    }
+
+
 def bench_speculative_decode(d_model=384, n_blocks=6, draft_blocks=1,
                              gamma=12, vocab=64, prompt_len=32,
                              new_tokens=96, n_prompts=4, rounds=3) -> dict:
@@ -1989,6 +2147,12 @@ def main() -> None:
         WORKLOADS["trace_aggregation"] = bench_trace_aggregation()
     except Exception as e:
         WORKLOADS["trace_aggregation"] = {"error": str(e)}
+
+    # ---- serving: fleet router N=2 vs single replica (ISSUE 13) ---------
+    try:
+        WORKLOADS["fleet_router"] = bench_fleet_router()
+    except Exception as e:
+        WORKLOADS["fleet_router"] = {"error": str(e)}
 
     # ---- analysis: race-checker disarmed-shim-cost A/B (ISSUE 8) --------
     try:

@@ -24,6 +24,10 @@ Usage:
   dl4j-tpu telemetry --targets http://h:p,http://h:p [--out trace.json]
                    [--serve-port P] [--interval S] [--duration S]
                    [--ui URL]
+  dl4j-tpu router  --spawn N --model model.zip [--journal journal.log]
+                   [--port P] [--quorum Q] [--kv-block B]
+                   [--affinity-blocks K] [--replica-arg ARG ...]
+                   | --replicas http://h:p,http://h:p (attach mode)
 """
 from __future__ import annotations
 
@@ -269,6 +273,33 @@ def cmd_telemetry(args) -> int:
     return telemetry.main(argv)
 
 
+def cmd_router(args) -> int:
+    """Fleet front-end (serving/router.py): journaled, prefix-affine
+    routing over N replica processes, with quorum readiness and
+    SLO-aware admission."""
+    from ..serving import router
+
+    argv = []
+    if args.replicas:
+        argv += ["--replicas", args.replicas]
+    if args.spawn:
+        argv += ["--spawn", str(args.spawn)]
+        rargs = (["--model", args.model] if args.model else [])
+        rargs += list(args.replica_arg or [])
+        # the = form: a forwarded fragment may itself start with --,
+        # which argparse would otherwise read as the next option
+        argv += [f"--replica-arg={ra}" for ra in rargs]
+    if args.journal:
+        argv += ["--journal", args.journal]
+    argv += ["--port", str(args.port),
+             "--kv-block", str(args.kv_block),
+             "--affinity-blocks", str(args.affinity_blocks),
+             "--quorum", str(args.quorum)]
+    if args.no_admission:
+        argv += ["--no-admission"]
+    return router.main(argv)
+
+
 def _add_data_args(p: argparse.ArgumentParser):
     p.add_argument("--input", required=True, help="input CSV path")
     p.add_argument("--batch", type=int, default=32)
@@ -438,6 +469,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="training-UI base URL for the /serving fleet "
                         "line")
     f.set_defaults(func=cmd_telemetry)
+
+    r = sub.add_parser("router",
+                       help="fleet front-end: journaled, prefix-affine "
+                            "routing over N engine replica processes")
+    r.add_argument("--replicas", default=None,
+                   help="attach to running replicas (comma-separated "
+                        "base URLs)")
+    r.add_argument("--spawn", type=int, default=0,
+                   help="spawn N replica subprocesses serving --model")
+    r.add_argument("--model", default=None,
+                   help="model zip every spawned replica serves")
+    r.add_argument("--replica-arg", action="append", default=[],
+                   help="extra argv forwarded to every spawned replica "
+                        "(repeatable; see python -m "
+                        "deeplearning4j_tpu.serving.replica --help)")
+    r.add_argument("--journal", default=None,
+                   help="durable request-journal path (a SIGKILLed "
+                        "router replays in-flight requests from it)")
+    r.add_argument("--port", type=int, default=0)
+    r.add_argument("--quorum", type=int, default=1,
+                   help="/readyz answers 200 only with >= this many "
+                        "ready replicas")
+    r.add_argument("--kv-block", type=int, default=16,
+                   help="the replicas' KV block size (the affinity "
+                        "hash aligns to it)")
+    r.add_argument("--affinity-blocks", type=int, default=1,
+                   help="how many leading prompt blocks the affinity "
+                        "hash covers")
+    r.add_argument("--no-admission", action="store_true",
+                   help="disable SLO-aware admission (route even while "
+                        "the fleet burns)")
+    r.set_defaults(func=cmd_router)
     return parser
 
 

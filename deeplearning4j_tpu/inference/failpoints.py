@@ -17,6 +17,20 @@ Seams (each is one `fire(name)` call at the code site):
   ``pool.alloc``           KVPool block allocation (paged engines)
   ``batcher.flush``        before a MicroBatcher batch dispatch
   ``http.handler``         top of every serving-server POST handler
+  ``router.journal``       before the fleet router appends a request to
+                           its durable journal (serving/router.py)
+  ``router.dispatch``      after the journal append, before the router
+                           forwards the request to a replica
+
+Cross-process arming (``DL4J_FAILPOINTS``): seams only fire in the
+process that armed them, so fleet chaos runs arm seams INSIDE replica
+(or router) subprocesses by exporting
+``DL4J_FAILPOINTS="name=spec;name2=spec"`` into the child environment —
+`serving/replica.py`'s entry point (and the router's, and `dl4j-tpu
+serve`) calls :func:`arm_from_env` at startup, and
+``ReplicaProcess(failpoints=...)`` sets the variable for one child. The
+specs are deterministic (seeded p-triggers, exact n-triggers), so a
+fleet chaos replay is the same fault sequence every run.
 
 Arming: ``arm("dispatch.decode", "crash@n:3")`` — the spec grammar is
 ``action[@trigger]``:
@@ -60,7 +74,8 @@ __all__ = ["InjectedFault", "InjectedCrash", "InjectedOOM", "InjectedHang",
 # the seams the serving stack actually plants (arming anything else is a
 # spec error — a typo'd seam name must not silently never fire)
 SEAMS = ("scheduler.iteration", "dispatch.decode", "dispatch.prefill",
-         "dispatch.verify", "pool.alloc", "batcher.flush", "http.handler")
+         "dispatch.verify", "pool.alloc", "batcher.flush", "http.handler",
+         "router.journal", "router.dispatch")
 
 
 class InjectedFault(RuntimeError):

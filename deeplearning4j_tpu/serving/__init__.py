@@ -2,6 +2,9 @@ from ..inference import (DecodeScheduler, MetricsRegistry, MicroBatcher,
                          QueueFullError, RequestTimeoutError)
 from .durable import (DurableLogConsumer, DurableLogProducer,
                       DurableStreamingTrainer)
+from .replica import ReplicaProcess, ReplicaSupervisor
+from .router import (FleetRouter, ReplicaEndpoint, RequestJournal,
+                     affinity_key, pick_replica)
 from .server import InferenceServer
 from .streaming import (QueueDataSetIterator, RecordToDataSetConverter,
                         ServeRoute, StreamingTrainingPipeline)
@@ -12,10 +15,12 @@ from .telemetry import (TRACE_HEADER, ClientTracer, FleetMetrics,
 
 __all__ = ["ClientTracer", "DecodeScheduler", "DurableLogConsumer",
            "DurableLogProducer", "DurableStreamingTrainer",
-           "FleetMetrics", "FleetTelemetryServer", "InferenceServer",
-           "MetricsRegistry", "MicroBatcher", "QueueDataSetIterator",
-           "QueueFullError", "RecordToDataSetConverter",
+           "FleetMetrics", "FleetRouter", "FleetTelemetryServer",
+           "InferenceServer", "MetricsRegistry", "MicroBatcher",
+           "QueueDataSetIterator", "QueueFullError",
+           "RecordToDataSetConverter", "ReplicaEndpoint",
+           "ReplicaProcess", "ReplicaSupervisor", "RequestJournal",
            "RequestTimeoutError", "ServeRoute",
            "StreamingTrainingPipeline", "TRACE_HEADER",
-           "TraceAggregator", "TraceContext", "format_trace_header",
-           "parse_trace_header"]
+           "TraceAggregator", "TraceContext", "affinity_key",
+           "format_trace_header", "parse_trace_header", "pick_replica"]

@@ -221,7 +221,13 @@ class DurableLogConsumer:
 
     ``poll`` returns the next records WITHOUT advancing the durable cursor;
     ``commit`` persists the new offset after the caller has processed them
-    (commit-after-process = at-least-once). The cursor file is written
+    (commit-after-process = at-least-once). ``commit_through(n)`` is the
+    partial form: it advances the durable cursor past only the first ``n``
+    delivered-but-uncommitted records — per-RECORD granularity, not
+    per-poll — so a consumer processing a polled batch out of lockstep
+    with its durability point (the fleet router acks journal entries as
+    replica responses land, not when the batch was read) replays only the
+    genuinely unprocessed tail after a crash. The cursor file is written
     atomically (tmp + rename + fsync) — the same torn-write discipline as
     parallel/statetracker.py checkpoints."""
 
@@ -235,6 +241,9 @@ class DurableLogConsumer:
         self.cursor_path = f"{path}.{group}.cursor"
         self.offset = self._load_cursor()
         self._pending_offset = self.offset
+        # end offset of every record delivered by poll() since the last
+        # commit, in delivery order — what commit_through(n) indexes into
+        self._delivered_offsets: List[int] = []
         self.corrupt_bytes_skipped = 0  # observability: resync cost so far
         self._badcrc_at = -1  # complete-frame CRC failure awaiting re-check
         self._badcrc_since = 0.0
@@ -246,15 +255,40 @@ class DurableLogConsumer:
         except (OSError, ValueError, KeyError):
             return 0
 
-    def commit(self) -> None:
+    def _write_cursor(self, offset: int) -> None:
         tmp = self.cursor_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"offset": self._pending_offset,
+            json.dump({"offset": offset,
                        "committed_at": time.time()}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.cursor_path)
-        self.offset = self._pending_offset
+        self.offset = offset
+
+    def commit(self) -> None:
+        self._write_cursor(self._pending_offset)
+        self._delivered_offsets.clear()
+
+    def commit_through(self, n: int) -> None:
+        """Durably commit the first ``n`` records delivered since the
+        last commit (cumulative across polls), leaving the rest
+        uncommitted: a crash after ``commit_through(n)`` replays from
+        record ``n + 1``, not from the whole batch. ``n`` past the
+        delivered count is an error — silently clamping would let a
+        caller believe work it never read is durable. ``n == 0`` is a
+        no-op (nothing newly durable), and re-committing an already
+        durable prefix is idempotent."""
+        if n < 0 or n > len(self._delivered_offsets):
+            raise ValueError(
+                f"commit_through({n}): only "
+                f"{len(self._delivered_offsets)} uncommitted records "
+                "have been delivered")
+        if n == 0:
+            return
+        target = self._delivered_offsets[n - 1]
+        if target > self.offset:
+            self._write_cursor(target)
+        del self._delivered_offsets[:n]
 
     def poll(self, max_records: int = 256) -> List:
         """Read up to max_records complete frames past the pending offset.
@@ -316,6 +350,7 @@ class DurableLogConsumer:
                 self._badcrc_at = -1
                 out.append(json.loads(payload.decode()))
                 self._pending_offset += _HDR.size + ln
+                self._delivered_offsets.append(self._pending_offset)
         return out
 
     _MAGIC_BYTES = struct.pack("<H", _MAGIC)

@@ -36,10 +36,17 @@ reports tokens/s — the reproducible-from-the-example form of
 `bench.py`'s `sharded_decode` row. On CPU the flag forces
 `--xla_force_host_platform_device_count=N` for you.
 
+Fleet serving (ISSUE 13): `--fleet N` spawns a prefix-affine
+`serving/router.py` front-end plus N engine replica PROCESSES and
+drives `/generate` through the router — reporting req/s, client p99,
+the durable-journal ledger (accepted/finished/lost), and the fleet
+prefix-cache hit rate that affinity routing protects.
+
     python examples/serving_load_test.py            # batched only
     python examples/serving_load_test.py --compare  # batched vs serialized
     python examples/serving_load_test.py --generate --trace-out trace.json
     python examples/serving_load_test.py --generate --mesh 4
+    python examples/serving_load_test.py --fleet 2
 """
 import argparse
 import json
@@ -355,6 +362,135 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
     return results
 
 
+def main_fleet(n_replicas=2, n_threads=4, reqs_each=8, prompt_len=48,
+               new_tokens=8, verbose=True):
+    """Fleet mode (ISSUE 13): spawn a prefix-affine router + N engine
+    replica PROCESSES (each a supervised `serving/replica.py`
+    subprocess over the same seeded LM), drive `/generate` through the
+    router with a repeated-prompt mix, and report req/s, client-side
+    p50/p95/p99, the journal ledger, and the FLEET prefix-cache hit
+    rate — the number affinity routing exists to protect: repeats of a
+    prompt land on the replica that already holds its blocks, so the
+    fleet's hit rate matches a single replica's instead of dividing by
+    N (`bench.py fleet_router` floor-gates the same invariant).
+
+        python examples/serving_load_test.py --fleet 2
+    """
+    import tempfile
+
+    from deeplearning4j_tpu.serving.replica import (ReplicaProcess,
+                                                    ReplicaSupervisor,
+                                                    lm_spec_argv)
+    from deeplearning4j_tpu.serving.router import FleetRouter
+
+    vocab = 32
+    wd = tempfile.mkdtemp(prefix="dl4j-fleet-")
+    argv = lm_spec_argv(vocab=vocab, d_model=32, n_heads=4, n_blocks=2,
+                        cache=prompt_len + new_tokens + 16) + [
+        "--slots", "4", "--prefill-chunk", "16",
+        "--prefix-cache-mb", "16", "--kv-block", "8"]
+    print(f"spawning {n_replicas} replica process(es) + router "
+          "(each replica pays a JAX import + warmup)...")
+    sup = ReplicaSupervisor(
+        [ReplicaProcess(argv, name=f"r{i}", workdir=wd)
+         for i in range(n_replicas)])
+    router = FleetRouter(supervisor=sup, quorum=n_replicas, kv_block=8,
+                         journal_path=os.path.join(wd, "journal.log"),
+                         scrape_interval_s=0.5).start()
+    rng = np.random.default_rng(0)
+    # two passes over one distinct-prompt set: pass 1 prefills cold and
+    # publishes, pass 2 repeats — the repeat must land on the replica
+    # already holding the blocks (two concurrent sends of the SAME
+    # prompt would race each other cold before the first publish, which
+    # measures scheduling luck, not routing)
+    bodies = [json.dumps(
+        {"prompt": rng.integers(0, vocab, prompt_len).tolist(),
+         "max_new_tokens": new_tokens}).encode()
+        for _ in range(max(1, n_threads * reqs_each // 2))]
+    results, errors, retry_counts = [], [], []
+
+    def client(k):
+        for i in range(k, len(bodies), n_threads):
+            try:
+                t0 = time.perf_counter()
+                r = _post(router.port, "/generate", bodies[i],
+                          retries=retry_counts)
+                r["client_ms"] = (time.perf_counter() - t0) * 1e3
+                results.append(r)
+            except Exception as e:
+                errors.append(repr(e))
+
+    def run_pass():
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def replica_counter(url, name):
+        m = json.loads(urllib.request.urlopen(
+            url + "/metrics", timeout=10).read())
+        return float(m["counters"].get(name, 0.0))
+
+    try:
+        # warm each replica's program families off the timed path
+        for _name, url in sup.ready_replicas():
+            _post(int(url.rsplit(":", 1)[1]), "/generate", json.dumps(
+                {"prompt": rng.integers(0, vocab, prompt_len).tolist(),
+                 "max_new_tokens": 2}).encode())
+        # hit-rate baseline AFTER warmup: the warmup prompts are
+        # guaranteed misses and must not dilute the measured rate
+        base = {url: (replica_counter(url,
+                                      "prefix_cache_hit_tokens_total"),
+                      replica_counter(
+                          url, "prefix_cache_lookup_tokens_total"))
+                for _name, url in sup.ready_replicas()}
+        t0 = time.perf_counter()
+        run_pass()   # cold: prefill + publish
+        run_pass()   # warm: every prompt repeats, affinity-routed
+        elapsed = time.perf_counter() - t0
+        hit = lookup = 0.0
+        for _name, url in sup.ready_replicas():
+            h0, l0 = base.get(url, (0.0, 0.0))
+            hit += replica_counter(
+                url, "prefix_cache_hit_tokens_total") - h0
+            lookup += replica_counter(
+                url, "prefix_cache_lookup_tokens_total") - l0
+        journal = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/router/journal",
+            timeout=10).read())
+        ready_n = sup.ready_count()
+    finally:
+        router.stop(stop_replicas=True)
+    assert not errors, errors
+    if verbose:
+        by_rep = {}
+        for r in results:
+            rep = (r.get("router") or {}).get("replica", "?")
+            by_rep[rep] = by_rep.get(rep, 0) + 1
+        retried = sum(1 for c in retry_counts if c)
+        print(f"fleet:      {ready_n}/{n_replicas} replicas ready, "
+              f"{len(results)} requests -> {len(results) / elapsed:6.1f} "
+              f"req/s  (per-replica load {by_rep}"
+              + (f", HTTP retries {sum(retry_counts)}" if retried else "")
+              + ")")
+        print(f"hit rate:   fleet prefix-cache "
+              f"{hit / max(1.0, lookup):.3f} "
+              f"({hit:.0f}/{lookup:.0f} tokens) — affinity keeps "
+              "repeats on the replica that holds their blocks")
+        print(f"journal:    {journal['accepted_total']} accepted, "
+              f"{journal['finished_total']} finished, "
+              f"{journal['failed_total']} failed, "
+              f"{journal['duplicate_finishes_suppressed']} dup-"
+              "suppressed")
+        print_timing_table(summarize_timings(results))
+        lost = journal["accepted_total"] - journal["finished_total"] \
+            - journal["failed_total"]
+        print(f"lost:       {lost} (accepted with no terminal record)")
+    return results
+
+
 def main(n_threads=8, reqs_each=10, rows=8, compare=False, verbose=True):
     net = _make_net()
     rng = np.random.default_rng(0)
@@ -414,8 +550,16 @@ if __name__ == "__main__":
                          "tensor-parallel over N devices (forces an "
                          "N-device virtual CPU mesh when needed) and "
                          "report tokens/s")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="spawn a prefix-affine fleet router + N engine "
+                         "replica PROCESSES and drive /generate through "
+                         "it; reports req/s, p99, and the fleet "
+                         "prefix-cache hit rate")
     a = ap.parse_args()
-    if a.generate:
+    if a.fleet:
+        main_fleet(n_replicas=a.fleet, n_threads=a.threads,
+                   reqs_each=a.requests)
+    elif a.generate:
         main_generate(n_threads=a.threads, reqs_each=a.requests,
                       trace_out=a.trace_out, mesh=a.mesh)
     else:

@@ -157,3 +157,33 @@ def test_trace_aggregation_healthy_row_passes():
     rows = {"trace_aggregation": {"step_time_ratio": 0.99,
                                   "merge_completeness": 1.0}}
     assert bench.check_floors(rows) == []
+
+
+def test_fleet_router_hit_rate_dilution_is_caught():
+    """ISSUE 13 acceptance floor: the N=2 fleet's prefix-cache hit rate
+    must stay at the single-replica level (affinity routing engaged).
+    A dilution regression — e.g. routing going round-robin so repeats
+    prefill cold on the other replica, halving the rate — must trip
+    the gate; so must any lost request or a token-identity break."""
+    diluted = {"fleet_router": {"hit_rate_ratio_vs_single": 0.52,
+                                "lost_requests": 0,
+                                "outputs_identical": 1}}
+    regs = bench.check_floors(diluted)
+    assert any("hit_rate_ratio_vs_single" in r for r in regs), regs
+
+    lossy = {"fleet_router": {"hit_rate_ratio_vs_single": 1.0,
+                              "lost_requests": 2,
+                              "outputs_identical": 1}}
+    regs = bench.check_floors(lossy)
+    assert any("lost_requests" in r for r in regs), regs
+
+    divergent = {"fleet_router": {"hit_rate_ratio_vs_single": 1.0,
+                                  "lost_requests": 0,
+                                  "outputs_identical": 0}}
+    regs = bench.check_floors(divergent)
+    assert any("outputs_identical" in r for r in regs), regs
+
+    healthy = {"fleet_router": {"hit_rate_ratio_vs_single": 1.0,
+                                "lost_requests": 0,
+                                "outputs_identical": 1}}
+    assert bench.check_floors(healthy) == []
