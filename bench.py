@@ -754,6 +754,130 @@ def bench_trace_overhead(prompt_len=64, new_tokens=24, chunk=32, vocab=64,
     }
 
 
+def bench_constrained_stream(prompt_len=48, new_tokens=24, chunk=16,
+                             vocab=29, n_reqs=4, rounds=6) -> dict:
+    """Constrained + streamed decoding A/B (ISSUE 14 acceptance). One
+    decode scheduler serves both sides interleaved: UNMASKED requests
+    (the original decode program) vs requests under an admit-everything
+    grammar (the masked program family — mask gather + additive 0 row).
+    Gates: masked/unmasked ``step_time_ratio`` >= 0.90 (the device mask
+    may cost at most ~10%), ``outputs_identical`` = 1 (admit-all is
+    token-identical to unconstrained, greedy AND seeded-sampled, and
+    the SSE-ordered stream equals the buffered result), and
+    ``outputs_valid`` = 1 (every JSON-schema-constrained completion
+    parses against its schema). TTFT is recorded from the stream
+    consumer's side (wall time to the first token event).
+    Standalone-runnable:
+        python -c "import bench, json; print(json.dumps(bench.bench_constrained_stream()))"
+    """
+    from deeplearning4j_tpu.inference import (DecodeScheduler,
+                                              MetricsRegistry,
+                                              TokenStream, admit_all,
+                                              compile_json_schema)
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    conf = transformer_lm(vocab_size=vocab, d_model=64, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            # +16 headroom: the schema-validity pass decodes a little
+            # past new_tokens so small objects complete
+            layer.max_cache_len = prompt_len + new_tokens + 16
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(0, vocab, prompt_len))
+               for _ in range(n_reqs)]
+    eng = DecodeScheduler(net, vocab, n_slots=4, prefill_chunk=chunk,
+                          metrics=MetricsRegistry()).start()
+    g_all = admit_all(vocab)
+    try:
+        # warm BOTH program families (masked decode compiles + the
+        # admit-all mask uploads) so the timed rounds are compile-free
+        for h in [eng.submit(p, 2) for p in prompts]:
+            h.result(600)
+        for h in [eng.submit(p, 2, grammar=g_all) for p in prompts]:
+            h.result(600)
+
+        def run_once(grammar, seed=None):
+            kw = ({"grammar": grammar} if grammar is not None else {})
+            if seed is not None:
+                kw.update(temperature=0.8, seed=seed)
+            t0 = time.perf_counter()
+            handles = [eng.submit(p, new_tokens, **kw) for p in prompts]
+            outs = [h.result(600) for h in handles]
+            return n_reqs * new_tokens / (time.perf_counter() - t0), outs
+
+        tps_plain = tps_masked = 0.0
+        base = masked = None
+        for _ in range(rounds):  # interleaved: drift hits both alike
+            tps, outs = run_once(None)
+            tps_plain = max(tps_plain, tps)
+            base = outs
+            tps, outs = run_once(g_all)
+            tps_masked = max(tps_masked, tps)
+            masked = outs
+        identical = int(base == masked)
+        # seeded-sampled identity rides the same acceptance bit
+        _, s_base = run_once(None, seed=11)
+        _, s_masked = run_once(g_all, seed=11)
+        identical = int(identical and s_base == s_masked)
+        # streamed == buffered: consume an SSE-order token stream under
+        # the admit-all grammar and time the first event (client TTFT)
+        ts = TokenStream()
+        t0 = time.perf_counter()
+        eng.submit(prompts[0], new_tokens, grammar=g_all, stream=ts)
+        ttft_ms = None
+        streamed = []
+        for evt in ts.events():
+            if evt.get("done"):
+                done = evt
+                break
+            if ttft_ms is None:
+                ttft_ms = (time.perf_counter() - t0) * 1e3
+            streamed.append(evt["token"])
+        identical = int(identical and streamed == done["tokens"] == base[0])
+        # structured-output validity: every schema-constrained sampled
+        # completion must parse against its schema
+        alphabet = ('"{}:,[]-' + "0123456789" + "abcdefghijk")[:vocab]
+        schema = {"type": "object", "properties": {
+            "a": {"type": "integer", "maxDigits": 2},
+            "b": {"type": "string", "maxLength": 3,
+                  "charset": "abc"}}}
+        g_schema = compile_json_schema(schema, alphabet)
+        valid = 1
+        for seed in range(3):
+            h = eng.generate_handle(prompts[0], new_tokens + 16,
+                                    timeout=600, grammar=g_schema,
+                                    temperature=1.0, seed=seed)
+            text = "".join(alphabet[t] for t in h.tokens)
+            try:
+                obj = json.loads(text)
+                ok = (isinstance(obj.get("a"), int)
+                      and set(obj.get("b", "")) <= set("abc"))
+            except ValueError:
+                ok = False
+            valid = int(valid and ok)
+    finally:
+        eng.stop()
+    return {
+        "tokens_per_sec_unmasked": round(tps_plain, 1),
+        "tokens_per_sec_masked": round(tps_masked, 1),
+        "step_time_ratio": round(tps_masked / tps_plain, 4),
+        "outputs_identical": identical,
+        "outputs_valid": valid,
+        "ttft_ms_stream": round(ttft_ms, 3) if ttft_ms else None,
+        "note": f"{n_reqs} concurrent {prompt_len}-token prompts x "
+                f"{new_tokens} tokens on a 2-block d64 LM, 4 slots; "
+                "masked = admit-all grammar through the device mask "
+                "table (gather + additive 0), unmasked = the original "
+                f"decode program; best-of-{rounds} interleaved rounds "
+                "(floors: ratio >= 0.90, identical = 1 incl. streamed "
+                "== buffered, schema completions valid = 1)",
+    }
+
+
 def bench_trace_aggregation(prompt_len=48, new_tokens=16, chunk=16,
                             vocab=32, n_reqs=6, rounds=6,
                             d_model=128) -> dict:
@@ -2169,6 +2293,12 @@ def main() -> None:
         WORKLOADS["best_of_n"] = bench_best_of_n()
     except Exception as e:
         WORKLOADS["best_of_n"] = {"error": str(e)}
+
+    # ---- serving: constrained + streamed decode A/B (ISSUE 14) ----------
+    try:
+        WORKLOADS["constrained_stream"] = bench_constrained_stream()
+    except Exception as e:
+        WORKLOADS["constrained_stream"] = {"error": str(e)}
 
     # ---- perf-regression gate vs committed floors (BENCH_FLOORS.json) ----
     regressions = check_floors(WORKLOADS)

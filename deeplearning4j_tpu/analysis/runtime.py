@@ -197,6 +197,29 @@ class CompileCounter:
         jdfixpos = getattr(scheduler, "_jdraft_fixpos", None)
         if jdfixpos is not None:
             c.track("draft_fixpos", jdfixpos, budget=1)
+        # grammar-constrained decoding (ISSUE 14): the masked decode /
+        # verify / draft-step variants add one mask-gather + add to the
+        # corresponding base program, so they inherit its bucketing —
+        # at most one masked-decode family member per table bucket, one
+        # masked draft step — and the mask UPLOAD program (admission
+        # path, never per-token) is <=1 per pow2 mask-row bucket. Zero
+        # per-request recompiles: grammar size is absorbed by the
+        # bucketed upload and the fixed [mask_rows, vocab] table shape.
+        jstep_m = getattr(scheduler, "_jstep_m", None)
+        if jstep_m is not None:
+            c.track("masked_decode", jstep_m,
+                    budget=max(1, tb) if paged else 1)
+        jverify_m = getattr(scheduler, "_jverify_m", None)
+        if jverify_m is not None:
+            c.track("masked_verify", jverify_m,
+                    budget=max(1, tb) if paged else 1)
+        jdstep_m = getattr(scheduler, "_jdraft_step_m", None)
+        if jdstep_m is not None:
+            c.track("masked_draft", jdstep_m, budget=1)
+        jmask_up = getattr(scheduler, "_jmask_upload", None)
+        if jmask_up is not None:
+            c.track("mask_upload", jmask_up,
+                    budget=len(getattr(scheduler, "mask_buckets", []) or []))
         return c
 
 

@@ -16,7 +16,8 @@ Usage:
                    [--generate [--vocab-size V] [--decode-slots N]
                     [--prefill-chunk C] [--kv-pool-mb MB]
                     [--prefix-cache-mb MB] [--kv-block B]
-                    [--kv-dtype int8] [--speculate GAMMA]
+                    [--kv-dtype int8] [--mask-rows N]
+                    [--speculate GAMMA]
                     [--draft-blocks K] [--tp N]]
                    [--no-supervise] [--hang-timeout S] [--retry-budget N]
                    [--slo-p99-ms MS] [--no-profiler]
@@ -124,6 +125,7 @@ def cmd_serve(args) -> int:
               kv_block=args.kv_block,
               kv_pool_mb=args.kv_pool_mb,
               kv_dtype=args.kv_dtype,
+              mask_rows=args.mask_rows,
               decode_tp=args.tp,
               speculate=args.speculate,
               draft_blocks=args.draft_blocks,
@@ -224,9 +226,13 @@ def cmd_serve(args) -> int:
                 "the degradation ladder)" if args.slo_p99_ms else "")
     prof_mode = ("" if not args.no_profiler
                  else ", profiler OFF (no phase/MFU attribution)")
+    mask_on = getattr(decoder, "maskpool", None) is not None
+    stream_mode = (", SSE streaming + constrained decoding"
+                   + (f" ({args.mask_rows} device mask rows)"
+                      if mask_on else " (host-only grammar masks)"))
     gen_mode = (f"; /generate: {args.decode_slots} slots, "
                 f"prefill chunk {args.prefill_chunk}" + kv_mode
-                + spec_mode + mesh_mode
+                + stream_mode + spec_mode + mesh_mode
                 + (f", supervised (hang timeout {args.hang_timeout}s, "
                    f"retry budget {args.retry_budget})"
                    if not args.no_supervise else ", UNSUPERVISED")
@@ -398,6 +404,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(per-row max-abs scales; less than half the "
                         "bytes per block, so the same --kv-pool-mb "
                         "holds 2x+ the blocks; paged mode only)")
+    s.add_argument("--mask-rows", type=int, default=64,
+                   help="device rows of the grammar mask table backing "
+                        "constrained decoding (/generate 'grammar': "
+                        "JSON-schema / trie DFAs compiled to per-state "
+                        "token masks; row 0 reserved admit-all; <=1 "
+                        "falls back to host-only masking)")
     s.add_argument("--speculate", type=int, default=0, metavar="GAMMA",
                    help="speculative decoding: draft GAMMA tokens per "
                         "slot per iteration with a shallow-exit draft "

@@ -27,6 +27,9 @@ from .engine import (DecodeHandle, DecodeScheduler, EngineCrashedError,
 from .failpoints import (InjectedCrash, InjectedFault, InjectedHang,
                          InjectedOOM)
 from .kvpool import KVPool
+from .logitproc import (CompiledGrammar, GrammarError, LogitState,
+                        StopMatcher, TokenStream, admit_all,
+                        compile_json_schema, compile_trie)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry)
 from .profiler import SLOMonitor, StepPhaseProfiler, program_costs
@@ -38,16 +41,20 @@ from .supervisor import (AdmissionRejectedError, EngineSupervisor,
                          RetryBudgetExceededError, ShuttingDownError)
 from .trace import FlightRecorder, default_recorder, new_request_id
 
-__all__ = ["AdmissionRejectedError", "Counter", "DecodeHandle",
+__all__ = ["AdmissionRejectedError", "CompiledGrammar", "Counter",
+           "DecodeHandle",
            "DecodeScheduler", "EngineCrashedError", "EngineSupervisor",
-           "FlightRecorder", "ForkGroup", "Gauge", "Histogram",
-           "InferenceFuture",
+           "FlightRecorder", "ForkGroup", "Gauge", "GrammarError",
+           "Histogram", "InferenceFuture",
            "InjectedCrash", "InjectedFault", "InjectedHang", "InjectedOOM",
-           "KVPool", "LoadSheddedError", "MetricsRegistry", "MicroBatcher",
+           "KVPool", "LoadSheddedError", "LogitState", "MetricsRegistry",
+           "MicroBatcher",
            "PromptTooLongError", "QueueFullError", "RequestTimeoutError",
            "RetryBudgetExceededError", "SLOMonitor", "ShuttingDownError",
-           "StepPhaseProfiler", "TP_AXIS",
+           "StepPhaseProfiler", "StopMatcher", "TP_AXIS", "TokenStream",
+           "admit_all",
            "bucket_for", "build_shallow_draft", "collective_counts",
+           "compile_json_schema", "compile_trie",
            "decode_mesh", "decode_program_hlo", "default_recorder",
            "default_registry", "draft_program_hlo",
            "new_request_id", "pow2_buckets", "prefill_program_hlo",

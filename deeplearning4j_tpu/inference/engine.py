@@ -105,6 +105,7 @@ from . import failpoints
 from .batcher import QueueFullError, bucket_for, pow2_buckets
 from .kvpool import (PAGE_KEYS, SCRATCH_BLOCK, KVPool, gather_blocks,
                      scatter_blocks)
+from .logitproc import CompiledGrammar, LogitState, MaskPool
 from .metrics import MetricsRegistry, default_registry
 from .profiler import StepPhaseProfiler, program_costs
 from .sharding import (TP_AXIS, decode_mesh, kv_heads_shardable,
@@ -165,6 +166,14 @@ class DecodeHandle:
         self.priority = int(priority)
         self.retries = 0  # crash-recovery resubmissions (supervisor)
         self.tokens: List[int] = []
+        # why the request ended: "length" | "eos" | "stop" | "grammar"
+        # | "cancelled" (None while decoding / on error) — echoed in
+        # the /generate response and the SSE terminal event
+        self.finish_reason: Optional[str] = None
+        # per-request token event queue (logitproc.TokenStream) for SSE
+        # streaming; the scheduler pushes released tokens as they
+        # decode, _finish() closes it with the terminal event
+        self.stream = None
         self._done = threading.Event()
         self._cancel = threading.Event()
         self._error: Optional[BaseException] = None
@@ -210,6 +219,11 @@ class DecodeHandle:
         self._error = err
         self.t_done = time.monotonic()
         self._done.set()
+        if self.stream is not None:
+            # the stream's terminal event (tokens are FINAL here — stop
+            # truncation happens before _finish): flushes any tokens the
+            # stop hold-back withheld, then the done record
+            self.stream.close(self, err)
 
     def _reset_for_retry(self) -> None:
         """Crash recovery (`inference/supervisor.py`): wipe the partial
@@ -233,6 +247,10 @@ class DecodeHandle:
         self.t_admitted = self.t_restored = None  # graftlint: disable=CC005
         self.t_first_token = self.t_done = None  # graftlint: disable=CC005
         self.steps_to_first_token = None
+        self.finish_reason = None
+        # self.stream is deliberately KEPT: its index-deduplicated
+        # pushes make the token-identical re-decode invisible to a
+        # streaming client (already-streamed indices are skipped)
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -264,7 +282,7 @@ class _ActiveSeq:
     __slots__ = ("handle", "prompt", "fed", "rng", "temperature", "top_k",
                  "top_p", "eos_id", "steps", "pool_node", "block_ids",
                  "shared", "written", "phase", "resumed", "folded",
-                 "cow_starved", "fork", "draft_fed")
+                 "cow_starved", "fork", "draft_fed", "proc")
 
     def __init__(self, handle: DecodeHandle, prompt: Sequence[int],
                  temperature: float, top_k: Optional[int],
@@ -301,6 +319,10 @@ class _ActiveSeq:
         # -- speculative decoding: tokens of `full_context()` the DRAFT
         # net has ingested (its contiguous cache row count / pos mirror)
         self.draft_fed = 0
+        # per-request logit-processor pipeline (logitproc.LogitState):
+        # penalty counts, grammar DFA state, stop matcher, device-mask
+        # residency. None for plain requests — the hot path unchanged.
+        self.proc: Optional[LogitState] = None
 
     def full_context(self) -> List[int]:
         """Every token the sequence is conditioned on so far (prompt —
@@ -415,6 +437,16 @@ class DecodeScheduler:
     surgery cannot cut (non-zoo graph shapes disable speculation with
     a RuntimeWarning).
 
+    ``mask_rows``: device rows of the grammar mask table
+    (`inference/logitproc.py`, ISSUE 14) — a fixed ``[mask_rows,
+    vocab]`` additive table (row 0 reserved admit-all) that
+    grammar-constrained requests' per-DFA-state token masks upload
+    into once at admission; the masked decode/verify/draft program
+    variants gather one row per slot and add it (0 allowed / -inf
+    forbidden) to the output distribution. <= 1 disables the device
+    table; grammars then mask host-side only (always correct — the
+    exact allow row applies at sampling either way).
+
     ``kv_dtype``: ``"int8"`` quantizes the PAGED pool's page arrays
     (per-(position, head) max-abs scales stored alongside; quantize on
     write, dequantize on gather) — less than half the bytes per block,
@@ -434,6 +466,7 @@ class DecodeScheduler:
                  max_queue: int = 64, prefill_chunk: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
                  kv_pool_mb: float = 0.0, kv_dtype: Optional[str] = None,
+                 mask_rows: int = 64,
                  mesh=None, speculate: int = 0,
                  draft_blocks: Optional[int] = None, draft_net=None,
                  metrics: Optional[MetricsRegistry] = None,
@@ -795,6 +828,35 @@ class DecodeScheduler:
             # the occasional copy-on-write block duplication (one more)
             self._jsetpos = jax.jit(self._setpos_fn)
             self._jcow = jax.jit(self._cow_fn)
+        # -- grammar-constrained decoding (ISSUE 14, logitproc.py) ---------
+        # a fixed [mask_rows, vocab] ADDITIVE device table (0 allowed,
+        # -inf forbidden; row 0 reserved all-zeros = admit-all). Each
+        # resident grammar's per-state rows upload ONCE at admission
+        # (pow2-bucketed chunks — a fixed upload family, never per-token
+        # work); the masked program variants gather one row per slot by
+        # DFA state and add it to the output distribution, so the decode
+        # family grows by at most one masked program per table bucket
+        # and unconstrained traffic keeps dispatching the original
+        # unmasked programs bit-for-bit.
+        self.mask_rows = int(mask_rows)
+        self.maskpool: Optional[MaskPool] = None
+        self._masks = None
+        self.mask_buckets: List[int] = []
+        self._jstep_m = None
+        self._jverify_m = None
+        self._jdraft_step_m = None
+        self._jmask_upload = None
+        if self.mask_rows > 1:
+            lo = min(8, self.mask_rows - 1)
+            self.mask_buckets = [b for b in pow2_buckets(self.mask_rows - 1)
+                                 if b >= lo]
+            self.maskpool = MaskPool(self.mask_rows, self.mask_buckets)
+            self._masks = self._dev_array(np.zeros(
+                (self.mask_rows, self.vocab_size), np.dtype(self._dtype)))
+            self._jstep_m = jax.jit(
+                self._step_masked_paged_fn if self.paged
+                else self._step_masked_fn)
+            self._jmask_upload = jax.jit(self._mask_upload_fn)
         # -- speculative decoding (ISSUE 10 tentpole) ----------------------
         # a cheap draft proposes `speculate` tokens per decode-ready slot
         # per iteration; ONE multi-token verify program (the chunked-
@@ -883,6 +945,15 @@ class DecodeScheduler:
                     else self._verify_fn)
                 self._jfixpos = jax.jit(self._fixpos_fn)
                 self._jdraft_fixpos = jax.jit(self._fixpos_fn)
+                if self._masks is not None:
+                    # masks compose with speculation: the draft proposes
+                    # under the same mask the verify applies (per-round
+                    # / per-position DFA states advanced host-side along
+                    # the proposed chain), acceptance rule untouched
+                    self._jverify_m = jax.jit(
+                        self._verify_masked_paged_fn if self.paged
+                        else self._verify_masked_fn)
+                    self._jdraft_step_m = jax.jit(self._draft_step_masked_fn)
         self._prefill_next = 0  # round-robin over prefilling slots
         self._emitted_this_iter = 0  # scheduler-thread-only tally
         m = self.metrics
@@ -903,6 +974,25 @@ class DecodeScheduler:
         self._m_ttft = m.histogram("decode_time_to_first_token_sec")
         self._m_step_time = m.histogram("decode_step_time_sec")
         self._m_prefill_tokens = m.counter("prefill_tokens_total")
+        # TTFT observability (ISSUE 14 satellite): the histogram SSE
+        # clients and the load-test phase table read, recorded at the
+        # same instant the request-track `first_token` trace instant is
+        # stamped (exemplar = request id, so a slow bucket links
+        # straight into /trace)
+        self._m_first_token = m.histogram(
+            "generate_first_token_seconds",
+            help="submit -> first output token (TTFT), seconds")
+        self._m_constrained = m.counter(
+            "constrained_requests_total",
+            help="requests submitted with a grammar constraint")
+        if self.maskpool is not None:
+            self._m_mask_rows = m.gauge(
+                "grammar_mask_rows_resident",
+                help="device mask-table rows held by resident grammars")
+            self._m_mask_spill = m.counter(
+                "grammar_mask_spills_total",
+                help="grammar admissions that fell back to host-only "
+                     "masking (mask table full or grammar too large)")
         self._m_prefill_chunk = m.histogram(
             "prefill_chunk_size", lo=1.0,
             hi=float(max(self.prefill_buckets or [1])) + 1, per_decade=12)
@@ -1070,6 +1160,63 @@ class DecodeScheduler:
         sts = self._inject_paged(states, table, live[:, None])
         out, new_states = self._forward(params, variables, x, sts)
         return out[:, -1, :], self._freeze_states(new_states, states, live)
+
+    # -- grammar-mask programs (logitproc.py, ISSUE 14) --------------------
+    def _mask_upload_fn(self, masks, start, rows):
+        """Write one grammar's mask rows into the device table at
+        ``start`` (1-element int32, same transfer contract as
+        `_zero_fn`). ``rows`` is padded to a pow2 bucket; pad rows are
+        zeros — admit-all rows inside the grammar's OWN allocation
+        (MaskPool allocates bucket-sized chunks), never another
+        grammar's. Admission-path only, one program per row bucket."""
+        return jax.lax.dynamic_update_slice(masks, rows, (start[0], 0))
+
+    def _step_masked_fn(self, params, variables, ids, live, mstate,
+                        masks, states):
+        """Decode step + grammar mask: gather each slot's current DFA
+        state's ADDITIVE row (0 allowed / -inf forbidden) from the mask
+        table and add it to the output distribution — one gather + add
+        on top of the unchanged decode forward, so this family mirrors
+        decode's bucketing exactly. Unconstrained slots point at row 0
+        (all zeros): ``p + 0.0 == p`` bitwise, which is what makes an
+        admit-everything grammar token-identical to unmasked decode."""
+        out, new_states = self._step_fn(params, variables, ids, live,
+                                        states)
+        return out + jnp.take(masks, mstate, axis=0), new_states
+
+    def _step_masked_paged_fn(self, params, variables, ids, live, table,
+                              mstate, masks, states):
+        out, new_states = self._step_paged_fn(params, variables, ids,
+                                              live, table, states)
+        return out + jnp.take(masks, mstate, axis=0), new_states
+
+    def _verify_masked_fn(self, params, variables, ids, live, mstate2,
+                          masks, states):
+        """Masked multi-token verify: position j's row gets the mask of
+        the DFA state the chain reaches after proposals[0..j-1]
+        (``mstate2`` [n_slots, gamma+1], computed host-side while
+        drafting) — the draft proposed under exactly these masks, so
+        verify scores like with like and the acceptance rule (which
+        re-applies the exact host-side allow row) is untouched."""
+        out, new_states = self._verify_fn(params, variables, ids, live,
+                                          states)
+        return out + jnp.take(masks, mstate2, axis=0), new_states
+
+    def _verify_masked_paged_fn(self, params, variables, ids, live,
+                                table, mstate2, masks, states):
+        out, new_states = self._verify_paged_fn(params, variables, ids,
+                                                live, table, states)
+        return out + jnp.take(masks, mstate2, axis=0), new_states
+
+    def _draft_step_masked_fn(self, params, variables, ids, live, mstate,
+                              masks, states):
+        """Masked draft step: the lockstep proposal round under the SAME
+        mask the verify applies — a draft that proposed out-of-grammar
+        tokens would have its whole chain rejected every round, turning
+        speculation into pure overhead on constrained traffic."""
+        out, new_states = self._draft_step_fn(params, variables, ids,
+                                              live, states)
+        return out + jnp.take(masks, mstate, axis=0), new_states
 
     # -- chunked prefill programs ------------------------------------------
     def _slice_slot(self, states, slot):
@@ -1628,6 +1775,7 @@ class DecodeScheduler:
             tr.begin("preempted", req=h.request_id)
         self._release_pool(seq)
         self._release_slot_blocks(slot, seq)
+        self._release_mask(seq)  # re-acquired (usually cached) on resume
         seq.prompt.extend(int(t) for t in h.tokens[seq.folded:])
         seq.folded = len(h.tokens)
         seq.fed = 0
@@ -1726,16 +1874,84 @@ class DecodeScheduler:
         return frozenset(self.pool.adopt(
             seq.prompt[:n_full * B], seq.block_ids[:n_full]))
 
+    # -- grammar mask residency (logitproc.MaskPool) -----------------------
+    def _attach_mask(self, slot: int, seq: _ActiveSeq) -> None:
+        """Make an admitted request's grammar device-resident: acquire
+        (or ref) its mask-row range and upload the additive table on
+        first residency — at ADMISSION, off the per-token path, so
+        constrained decode steps pay only the in-program gather. A
+        grammar that cannot fit falls back to HOST-ONLY masking
+        (mask_base None): the exact allow row still applies at sampling
+        — correctness never depends on residency, only the device-side
+        assist (and the draft's in-grammar proposals) does."""
+        proc = seq.proc
+        if proc is None or proc.grammar is None or self.maskpool is None:
+            return
+        g = proc.grammar
+        start, upload = self.maskpool.acquire(g)
+        if start is None:
+            proc.mask_base = None
+            self._m_mask_spill.inc()
+            return
+        if upload:
+            bucket = bucket_for(g.n_states, self.mask_buckets)
+            rows = np.zeros((bucket, self.vocab_size),
+                            np.dtype(self._dtype))
+            rows[:g.n_states] = g.mask_table(np.dtype(self._dtype))
+            # _masks is scheduler-thread-only past start() (attach runs
+            # in _admit), same single-writer protocol as _states
+            self._masks = self._jmask_upload(  # graftlint: disable=CC005
+                self._masks, self._dev_index(start),
+                self._dev_array(rows))
+        proc.mask_base = start
+        self._m_mask_rows.set(self.maskpool.resident_rows())
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "grammar_attach", track=self._slot_tracks[slot],
+                args={"request": seq.handle.request_id,
+                      "states": g.n_states, "row": start,
+                      "uploaded": bool(upload)})
+
+    def _release_mask(self, seq: _ActiveSeq) -> None:
+        """Drop the request's mask-row reference (every slot-freeing
+        path — finish, cancel, preempt, stop, crash — comes through
+        here; the rows stay CACHED for the next request sharing the
+        grammar until pool pressure evicts zero-ref entries)."""
+        proc = seq.proc
+        if proc is not None and proc.mask_base is not None:
+            self.maskpool.release(proc.grammar.key)
+            proc.mask_base = None
+            self._m_mask_rows.set(self.maskpool.resident_rows())
+
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None, seed: int = 0,
                eos_id: Optional[int] = None,
                request_id: Optional[str] = None, priority: int = 0,
+               stop: Optional[Sequence[Sequence[int]]] = None,
+               grammar: Optional[CompiledGrammar] = None,
+               repetition_penalty: Optional[float] = None,
+               presence_penalty: Optional[float] = None,
+               frequency_penalty: Optional[float] = None,
+               stream=None,
                fork: Optional[ForkGroup] = None,
                _handle: Optional[DecodeHandle] = None,
                _front: bool = False) -> DecodeHandle:
-        """``priority``: degradation-ladder shedding order (higher
+        """``stop``: multi-token stop sequences (list of token-id lists)
+        matched across token boundaries; a match truncates the output
+        before the stop sequence and finishes the request
+        (``finish_reason="stop"``). ``grammar``: a pre-compiled
+        `logitproc.CompiledGrammar` (compiled AHEAD of admission — the
+        serving layer caches compiles by content); forbidden tokens get
+        probability exactly 0 and the grammar's device mask rows attach
+        at admission. ``repetition_penalty`` / ``presence_penalty`` /
+        ``frequency_penalty``: host-side probability-row penalties over
+        generated-token counts. ``stream``: a `logitproc.TokenStream`
+        the scheduler pushes released tokens into as they decode (the
+        SSE backing; crash-recovery re-decodes dedupe by token index).
+
+        ``priority``: degradation-ladder shedding order (higher
         survives longer; default 0). ``fork``: best-of-n candidate
         group (`speculative.ForkGroup`, see :meth:`generate_many`) —
         the first submission becomes the primary; follower candidates
@@ -1795,11 +2011,29 @@ class DecodeScheduler:
                     f"prompt ({len(prompt_ids)}) + max_new_tokens "
                     f"({max_new_tokens}) needs a KV cache of {needed} but "
                     f"max_cache_len={self._cache_cap}")
+        # the per-request logit pipeline is built HERE — including the
+        # supervisor's crash-recovery resubmission, whose kwargs carry
+        # the same grammar/stop/penalty spec — so a token-identical
+        # re-decode re-observes from a clean pipeline state
+        proc = None
+        if (grammar is not None or stop or repetition_penalty
+                or presence_penalty or frequency_penalty):
+            proc = LogitState(self.vocab_size, grammar=grammar, stop=stop,
+                              repetition_penalty=repetition_penalty,
+                              presence_penalty=presence_penalty,
+                              frequency_penalty=frequency_penalty)
+            if grammar is not None and _handle is None:
+                # _handle set = the supervisor's crash-recovery
+                # resubmission of a request already counted once
+                self._m_constrained.inc()
         handle = _handle if _handle is not None else DecodeHandle(
             len(prompt_ids), max_new_tokens, request_id=rid,
             priority=priority)
+        if stream is not None:
+            handle.stream = stream
         seq = _ActiveSeq(handle, prompt_ids, temperature, top_k, top_p,
                          seed, eos_id)
+        seq.proc = proc
         if fork is not None:
             fork.bind_primary(handle)
             seq.fork = fork
@@ -1933,6 +2167,7 @@ class DecodeScheduler:
                     self._release_pool(seq)
                     if self.paged:
                         self._release_slot_blocks(i, seq)
+                self._release_mask(seq)
                 seq.handle._finish(RuntimeError("scheduler stopped"))
                 self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
@@ -1990,6 +2225,10 @@ class DecodeScheduler:
                     self._release_pool(seq)
                     if self.paged:
                         self._release_slot_blocks(i, seq)
+                # a cancel (incl. the streaming layer's client-
+                # disconnect path) releases the grammar mask pin too
+                self._release_mask(seq)
+                seq.handle.finish_reason = "cancelled"
                 seq.handle._finish()  # partial tokens, caller already left
                 self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
@@ -2045,6 +2284,7 @@ class DecodeScheduler:
                     if seq.handle.cancelled():  # gave up while queued
                         self._queue.pop(qi)
                         self._m_cancelled.inc()
+                        seq.handle.finish_reason = "cancelled"
                         seq.handle._finish()
                         self._trace_done("cancel", seq)
                         continue
@@ -2101,6 +2341,10 @@ class DecodeScheduler:
                     self._try_restore_paged(i, seq)
                 else:
                     self._try_restore(i, seq)
+            # grammar mask upload rides the admission window too (a
+            # preempted-and-resumed request re-acquires here — its rows
+            # are usually still cached, so this is a refcount bump)
+            self._attach_mask(i, seq)
             h.t_restored = time.monotonic()
             tr.end("prefix_restore", req=rid,
                    args={"hit_tokens": seq.fed, "slot": i,
@@ -2119,8 +2363,19 @@ class DecodeScheduler:
         yields the first output token). Token-count metrics are NOT
         updated here — the loop flushes one batched `inc(n)` per
         iteration instead of taking the counter lock once per token."""
-        tok = sample_logits(probs_row, seq.temperature, seq.top_k,
-                            seq.rng, seq.top_p)
+        proc = seq.proc
+        if proc is None:
+            tok = sample_logits(probs_row, seq.temperature, seq.top_k,
+                                seq.rng, seq.top_p)
+        else:
+            # penalty-adjust + EXACT host-side grammar mask (forbidden
+            # tokens get probability 0 whatever the device mask did),
+            # then observe — the pipeline's state advances on emitted
+            # tokens only, in emission order
+            tok = sample_logits(proc.adjust(probs_row), seq.temperature,
+                                seq.top_k, seq.rng, seq.top_p,
+                                allow=proc.allow_row())
+            proc.advance(tok)
         self._emit(slot, seq, tok)
 
     def _fork_publish(self, slot: int, seq: _ActiveSeq) -> None:
@@ -2163,13 +2418,30 @@ class DecodeScheduler:
             # a token (or finishing) here would corrupt/duplicate it
             raise _EngineFenced
         h = seq.handle
+        if h.done():
+            return  # a speculative chain can run past a stop-sequence /
+            # grammar finish: the tail tokens were sampled (RNG spent on
+            # a finished request — harmless) but must not be appended
         h.tokens.append(tok)
         self._emitted_this_iter += 1
         now = time.monotonic()
         if h.t_first_token is None:
             h.t_first_token = now
             h.steps_to_first_token = seq.steps
-            self._m_ttft.record(now - h.t_submit)
+            ttft = now - h.t_submit
+            # two series, one value, deliberately: decode_time_to_
+            # first_token_sec is the PR-1-era name dashboards already
+            # scrape; generate_first_token_seconds (exemplar-linked
+            # into /trace) is the ISSUE 14 streaming-TTFT contract
+            self._m_ttft.record(ttft)
+            self._m_first_token.record(ttft, exemplar=h.request_id)
+            if self.tracer.enabled:
+                # the request waterfall's TTFT marker (ISSUE 14
+                # satellite): right where prefill hands off to decode
+                self.tracer.instant(
+                    "first_token", req=h.request_id,
+                    args={"request_id": h.request_id,
+                          "ttft_ms": round(ttft * 1e3, 3)})
         if seq.phase == "prefill":
             # phase boundary on the request track: prompt ingestion is
             # over the moment the first output token exists. Keyed on
@@ -2183,25 +2455,62 @@ class DecodeScheduler:
                     and seq.fork.primary_handle is h
                     and not seq.fork.published):
                 self._fork_publish(slot, seq)
+        proc = seq.proc
+        if proc is not None:
+            # stop sequences match across token boundaries (Aho-Corasick
+            # over the emitted stream — a stop split across speculative
+            # bursts still matches); the matched tokens are truncated
+            # OFF the output before the handle finishes
+            matched = proc.stop_feed(tok)
+            if matched:
+                del h.tokens[len(h.tokens) - matched:]
+                h.finish_reason = "stop"
+                self._retire(slot, seq, now)
+                return
+        if h.stream is not None:
+            # streaming release with stop hold-back: tokens that form a
+            # live partial stop match are withheld (flushed by the next
+            # mismatch, or discarded by the truncation above) so an SSE
+            # client never sees half a stop sequence
+            safe = len(h.tokens) - (proc.stop_pending
+                                    if proc is not None else 0)
+            for idx in range(h.stream.sent, safe):
+                h.stream.push(idx, h.tokens[idx])
         if (len(h.tokens) >= h.max_new_tokens
                 or (seq.eos_id is not None and tok == seq.eos_id)):
-            if self.pool is not None:
-                # retain the prompt's prefill-written blocks for the next
-                # request sharing this prefix, then drop our own pin.
-                # Paged: pure ownership transfer (trie adopts the pages
-                # in place); contiguous: jitted scatter into the side
-                # pool's storage
-                if self.paged:
-                    adopted = self._publish_paged(slot, seq)
-                    self._release_pool(seq)
-                    self._release_slot_blocks(slot, seq, keep=adopted)
-                else:
-                    self._publish_prompt(slot, seq)
-                    self._release_pool(seq)
-            h._finish()
-            self._trace_done("finish", seq, slot=slot)
-            self._m_latency.record(now - h.t_submit)
-            self._slots[slot] = None
+            h.finish_reason = ("eos" if seq.eos_id is not None
+                               and tok == seq.eos_id else "length")
+            self._retire(slot, seq, now)
+
+    def _retire(self, slot: int, seq: _ActiveSeq,
+                now: Optional[float] = None) -> None:
+        """Finish + evict one slot-resident sequence — max tokens, EOS,
+        stop-sequence match, or grammar completion. The single
+        retirement path: publish the prompt's blocks for the next
+        prefix sharer, drop pool + mask pins, finish the handle (which
+        closes its token stream with the terminal event), free the
+        slot."""
+        if now is None:
+            now = time.monotonic()
+        h = seq.handle
+        if self.pool is not None:
+            # retain the prompt's prefill-written blocks for the next
+            # request sharing this prefix, then drop our own pin.
+            # Paged: pure ownership transfer (trie adopts the pages
+            # in place); contiguous: jitted scatter into the side
+            # pool's storage
+            if self.paged:
+                adopted = self._publish_paged(slot, seq)
+                self._release_pool(seq)
+                self._release_slot_blocks(slot, seq, keep=adopted)
+            else:
+                self._publish_prompt(slot, seq)
+                self._release_pool(seq)
+        self._release_mask(seq)
+        h._finish()
+        self._trace_done("finish", seq, slot=slot)
+        self._m_latency.record(now - h.t_submit)
+        self._slots[slot] = None
 
     def _run_prefill_chunk(self) -> Optional[int]:
         """At most one bounded prefill chunk per iteration (round-robin
@@ -2393,19 +2702,59 @@ class DecodeScheduler:
         for i, _seq, _k, _l, _t, _p in info:
             live[i] = True
         ldev = self._dev_array(live)
+        # grammar composition: per-slot SPECULATIVE DFA state chain —
+        # schain[i][j] is the state after proposals[0..j-1], starting
+        # from the pipeline's live state (every emitted token already
+        # observed). Drives the per-round draft mask, the per-position
+        # verify mask, and the host-exact mask on draft argmax rows.
+        schain: Dict[int, List[int]] = {}
+        use_mask = False
+        for i, seq, _k, _l, _t, _p in info:
+            p = seq.proc
+            if p is not None and p.grammar is not None:
+                schain[i] = [p.gstate]
+                if self._jdraft_step_m is not None \
+                        and p.mask_base is not None:
+                    use_mask = True
         for r in range(G):
             ids = np.zeros((self.n_slots,), np.int32)
             for i, seq, known, lag, tail, props in info:
                 ids[i] = tail[r] if r < lag else props[r - lag]
             self.profiler.count("draft", 0)
-            dprobs, self._draft_states = self._jdraft_step(
-                dp, dv, self._dev_array(ids), ldev, self._draft_states)
+            if use_mask:
+                # the draft proposes under the same mask verify applies:
+                # each round gathers the chain-state-so-far's mask row
+                mstate = np.zeros((self.n_slots,), np.int32)
+                for i, seq, _k, _l, _t, _p in info:
+                    p = seq.proc
+                    if p is not None and p.mask_base is not None:
+                        mstate[i] = p.mask_base + schain[i][-1]
+                dprobs, self._draft_states = self._jdraft_step_m(
+                    dp, dv, self._dev_array(ids), ldev,
+                    self._dev_array(mstate), self._masks,
+                    self._draft_states)
+            else:
+                dprobs, self._draft_states = self._jdraft_step(
+                    dp, dv, self._dev_array(ids), ldev,
+                    self._draft_states)
             rows = host_read(dprobs)
             for i, seq, known, lag, tail, props in info:
                 if r >= lag - 1:  # catch-up rounds' outputs are known
                     # rows is host numpy (the host_read above IS the
                     # sanctioned boundary); this int() syncs nothing
-                    props.append(int(rows[i].argmax()))  # graftlint: disable=JG006
+                    row = rows[i]
+                    if i in schain:
+                        # host-exact mask on the proposal argmax (covers
+                        # host-only grammars the device never masked):
+                        # softmax rows are >= 0, so -1 can never win
+                        g = seq.proc.grammar
+                        allow = g.allow[schain[i][-1]]
+                        row = np.where(allow, row, -1.0)
+                        prop = int(row.argmax())  # graftlint: disable=JG006
+                        schain[i].append(g.step(schain[i][-1], prop))
+                        props.append(prop)
+                        continue
+                    props.append(int(row.argmax()))  # graftlint: disable=JG006
         # seam BEFORE any span opens (the decode/prefill seam ordering:
         # an injected crash must not strand unclosed B-events)
         failpoints.fire("dispatch.verify")
@@ -2420,18 +2769,43 @@ class DecodeScheduler:
                                  "proposed": len(props)})
                 tr.begin("verify", req=seq.handle.request_id,
                          args={"slot": i, "proposed": len(props)})
+        mstate2 = None
+        if use_mask:
+            # position j's mask = the state after proposals[0..j-1]
+            # (exactly what the draft proposed under); pad lanes repeat
+            # the last state — their rows are never read
+            mstate2 = np.zeros((self.n_slots, G + 1), np.int32)
+            for i, seq, _k, _l, _t, props in info:
+                p = seq.proc
+                if p is not None and p.mask_base is not None:
+                    chain = schain[i]
+                    padded = chain + [chain[-1]] * (G + 1 - len(chain))
+                    mstate2[i] = [p.mask_base + s
+                                  for s in padded[:G + 1]]
         if self.paged:
             table = self._table_for(max(s.written + G + 1
                                         for _, s, _k, _l, _t, _p in info))
             self.profiler.count("verify", table.shape[1])
-            vprobs, self._states = self._jverify(
-                self._params, self._variables, self._dev_array(ids2),
-                ldev, self._dev_array(table), self._states)
+            if mstate2 is not None:
+                vprobs, self._states = self._jverify_m(
+                    self._params, self._variables, self._dev_array(ids2),
+                    ldev, self._dev_array(table),
+                    self._dev_array(mstate2), self._masks, self._states)
+            else:
+                vprobs, self._states = self._jverify(
+                    self._params, self._variables, self._dev_array(ids2),
+                    ldev, self._dev_array(table), self._states)
         else:
             self.profiler.count("verify", 0)
-            vprobs, self._states = self._jverify(
-                self._params, self._variables, self._dev_array(ids2),
-                ldev, self._states)
+            if mstate2 is not None:
+                vprobs, self._states = self._jverify_m(
+                    self._params, self._variables, self._dev_array(ids2),
+                    ldev, self._dev_array(mstate2), self._masks,
+                    self._states)
+            else:
+                vprobs, self._states = self._jverify(
+                    self._params, self._variables, self._dev_array(ids2),
+                    ldev, self._states)
         rows2 = host_read(vprobs)
         posv = np.zeros((self.n_slots,), np.int32)
         dposv = np.zeros((self.n_slots,), np.int32)
@@ -2442,7 +2816,7 @@ class DecodeScheduler:
             remaining = h.max_new_tokens - len(h.tokens)
             emitted, matched = accept_tokens(
                 rows2[i], props, seq.temperature, seq.top_k, seq.top_p,
-                seq.rng, remaining, seq.eos_id)
+                seq.rng, remaining, seq.eos_id, proc=seq.proc)
             proposed += len(props)
             accepted += matched
             seq.steps += 1
@@ -2525,6 +2899,14 @@ class DecodeScheduler:
         for i, seq in cands:
             if self._slots[i] is not seq or i == chunked:
                 continue  # evicted/preempted above / consumed its turn
+            if seq.sampling and seq.proc is not None \
+                    and seq.proc.exhausted():
+                # the grammar admits nothing more: the structured output
+                # is COMPLETE — finish before any dispatch (sampling an
+                # all-forbidden row has no meaning)
+                seq.handle.finish_reason = "grammar"
+                self._retire(i, seq)
+                continue
             if not seq.sampling and self.prefill_buckets \
                     and self._pick_chunk(seq)[1]:
                 continue  # mid-prefill: waits for its chunk turn
@@ -2542,6 +2924,19 @@ class DecodeScheduler:
             for i, seq in fed:
                 ids[i] = seq.next_input()
                 live[i] = True
+            # masked dispatch only when a DEVICE-RESIDENT grammar is in
+            # the batch: pure unconstrained traffic (and host-only
+            # fallback grammars) keeps the original program — the
+            # single jitted decode program survives constrained serving
+            mstate = None
+            if self._masks is not None:
+                for i, seq in fed:
+                    p = seq.proc
+                    if p is not None and p.mask_base is not None:
+                        if mstate is None:
+                            mstate = np.zeros((self.n_slots,), np.int32)
+                        # unconstrained slots stay at row 0 (all zeros)
+                        mstate[i] = p.mask_base + p.gstate
             failpoints.fire("dispatch.decode")
             if self.tracer.enabled:  # keep tracing-off allocation-free
                 self.tracer.begin("decode_step", track=self._sched_track,
@@ -2550,15 +2945,30 @@ class DecodeScheduler:
                 table = self._table_for(max(s.written + 1
                                             for _, s in fed))
                 prof.count("decode", table.shape[1])
-                probs, new_states = self._jstep(
-                    self._params, self._variables, self._dev_array(ids),
-                    self._dev_array(live), self._dev_array(table),
-                    self._states)
+                if mstate is not None:
+                    probs, new_states = self._jstep_m(
+                        self._params, self._variables,
+                        self._dev_array(ids), self._dev_array(live),
+                        self._dev_array(table), self._dev_array(mstate),
+                        self._masks, self._states)
+                else:
+                    probs, new_states = self._jstep(
+                        self._params, self._variables,
+                        self._dev_array(ids), self._dev_array(live),
+                        self._dev_array(table), self._states)
             else:
                 prof.count("decode", 0)
-                probs, new_states = self._jstep(
-                    self._params, self._variables, self._dev_array(ids),
-                    self._dev_array(live), self._states)
+                if mstate is not None:
+                    probs, new_states = self._jstep_m(
+                        self._params, self._variables,
+                        self._dev_array(ids), self._dev_array(live),
+                        self._dev_array(mstate), self._masks,
+                        self._states)
+                else:
+                    probs, new_states = self._jstep(
+                        self._params, self._variables,
+                        self._dev_array(ids), self._dev_array(live),
+                        self._states)
             self._states = new_states
             probs = host_read(probs)
             prof.lap("decode")
@@ -2701,6 +3111,7 @@ class DecodeScheduler:
                     self._release_pool(seq)
                     if self.paged:
                         self._release_slot_blocks(i, seq)
+                self._release_mask(seq)
                 seq.handle._finish(exc)
                 self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
@@ -2731,11 +3142,21 @@ class DecodeScheduler:
         with self._cond:
             return len(self._queue)
 
-    def warmup(self) -> None:
+    def warmup(self, masks: Optional[bool] = None) -> None:
         """Compile every program family up front by invoking each jitted
         callable once per bucket shape and DISCARDING the results (the
         programs are pure; nothing observable changes — no metrics, no
         trace records, no pool state, no slot bookkeeping).
+
+        ``masks``: also warm the GRAMMAR-MASKED program variants
+        (masked decode/verify/draft + the mask-upload family). Default
+        (None) warms them only when grammars are already resident —
+        unconstrained serving must not pay the near-2x warmup of a
+        family it never dispatches (supervisor rebuilds run this inside
+        the recovery window). A deployment expecting constrained
+        traffic warms eagerly with ``warmup(masks=True)``; otherwise
+        the first constrained dispatch pays one bounded lazy compile
+        per family member, exactly like a cold chunk bucket.
 
         Why this exists: a rebuilt engine's jit caches start empty, and
         first-call compiles block the scheduler loop mid-iteration —
@@ -2801,6 +3222,34 @@ class DecodeScheduler:
                         self._dev_array(np.zeros((b,), np.int32)),
                         self.pool.storage)
         self._jzero(self._states, slot0)
+        if masks is None:
+            masks = (self.maskpool is not None
+                     and self.maskpool.resident_rows() > 0)
+        if masks and self._masks is not None:
+            # masked-decode family: one program per table bucket, like
+            # decode — a constrained request after a supervisor swap
+            # must not pay this compile mid-iteration
+            mstate0 = self._dev_array(np.zeros((self.n_slots,), np.int32))
+            if self.paged:
+                for nb in self.table_buckets:
+                    table = self._dev_array(np.full(
+                        (self.n_slots, nb), SCRATCH_BLOCK, np.int32))
+                    self._jstep_m(params, variables, ids, live, table,
+                                  mstate0, self._masks, self._states)
+            else:
+                self._jstep_m(params, variables, ids, live, mstate0,
+                              self._masks, self._states)
+            if self.maskpool.resident_rows() == 0:
+                # upload family (pure writes of zeros = admit-all rows).
+                # Guarded: on a warm engine that already holds resident
+                # grammar tables, re-zeroing rows [0, bucket) would
+                # corrupt them — and those engines compiled the family
+                # long ago anyway
+                for b in self.mask_buckets:
+                    self._masks = self._jmask_upload(
+                        self._masks, slot0,
+                        self._dev_array(np.zeros(
+                            (b, self.vocab_size), np.dtype(self._dtype))))
         if self.speculate:
             # speculation's program family: the multi-token verify (per
             # table bucket in paged mode, like decode), the draft's
@@ -2818,6 +3267,26 @@ class DecodeScheduler:
                 self._jverify(params, variables, ids2, live, self._states)
             dp, dv = self._draft_params, self._draft_variables
             self._jdraft_step(dp, dv, ids, live, self._draft_states)
+            if masks and self._jverify_m is not None:
+                # speculation x grammar composition: the masked verify
+                # mirrors verify's table bucketing, the masked draft
+                # step is a singleton
+                mstate0 = self._dev_array(np.zeros((self.n_slots,),
+                                                   np.int32))
+                mstate2 = self._dev_array(np.zeros(
+                    (self.n_slots, self.speculate + 1), np.int32))
+                if self.paged:
+                    for nb in self.table_buckets:
+                        table = self._dev_array(np.full(
+                            (self.n_slots, nb), SCRATCH_BLOCK, np.int32))
+                        self._jverify_m(params, variables, ids2, live,
+                                        table, mstate2, self._masks,
+                                        self._states)
+                else:
+                    self._jverify_m(params, variables, ids2, live,
+                                    mstate2, self._masks, self._states)
+                self._jdraft_step_m(dp, dv, ids, live, mstate0,
+                                    self._masks, self._draft_states)
             for b in self.prefill_buckets:
                 self._jdraft_prefill(
                     dp, dv, slot0,
@@ -2912,6 +3381,8 @@ class DecodeScheduler:
             "mesh": {"tp": self.tp},
             "chunk_cap": self.chunk_cap,
         }
+        if self.maskpool is not None:
+            out["grammar_masks"] = self.maskpool.stats()
         if self.pool is not None:
             try:
                 out["pool"] = self.pool.stats()

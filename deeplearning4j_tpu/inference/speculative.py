@@ -133,8 +133,8 @@ def await_fork_group(handles: Sequence, timeout: Optional[float],
 def accept_tokens(rows: np.ndarray, proposals: Sequence[int],
                   temperature: float, top_k: Optional[int],
                   top_p: Optional[float], rng: np.random.Generator,
-                  max_tokens: int, eos_id: Optional[int]
-                  ) -> Tuple[List[int], int]:
+                  max_tokens: int, eos_id: Optional[int],
+                  proc=None) -> Tuple[List[int], int]:
     """Token-identical acceptance over one verified chain.
 
     ``rows``: the target's per-position next-token distributions for the
@@ -151,6 +151,17 @@ def accept_tokens(rows: np.ndarray, proposals: Sequence[int],
     bonus token for free. RNG is never consumed past the stop, so the
     sequence's sampling stream stays in lockstep with solo decode.
 
+    ``proc`` (`logitproc.LogitState`, or None): the request's
+    logit-processor pipeline. Each position's TARGET row is penalty-
+    adjusted and grammar-masked exactly as solo decode's `_consume`
+    would have (same host-side ``allow`` row, same RNG draw), and the
+    pipeline OBSERVES each emitted token here — walking the chain IS
+    the emission order, so grammar state and penalty counts at position
+    j+1 reflect token j, identical to token-by-token decode. A grammar
+    that exhausts mid-chain stops acceptance early (the engine then
+    finishes the request); masks therefore compose with speculation
+    without touching the acceptance rule.
+
     Returns ``(emitted, matched)``: the 1..g+1 accepted tokens and how
     many draft proposals they confirmed (the acceptance-rate metric).
     """
@@ -160,8 +171,18 @@ def accept_tokens(rows: np.ndarray, proposals: Sequence[int],
     for j in range(g + 1):
         if len(emitted) >= max_tokens:
             break
-        tok = sample_logits(rows[j], temperature, top_k, rng, top_p)
+        if proc is not None and proc.exhausted():
+            break  # grammar complete: later rows must not consume RNG
+        row = rows[j]
+        allow = None
+        if proc is not None:
+            row = proc.adjust(row)
+            allow = proc.allow_row()
+        tok = sample_logits(row, temperature, top_k, rng, top_p,
+                            allow=allow)
         emitted.append(tok)
+        if proc is not None:
+            proc.advance(tok)
         if eos_id is not None and tok == eos_id:
             if j < g and tok == proposals[j]:
                 matched += 1

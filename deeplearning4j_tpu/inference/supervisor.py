@@ -259,12 +259,25 @@ class EngineSupervisor:
         every bucket's program with pure discarded calls — no metrics,
         trace, or pool side effects). A failure is traced, never
         swallowed, and never fatal: an unwarmed engine still serves,
-        it just compiles under traffic."""
+        it just compiles under traffic.
+
+        When any TRACKED in-flight request carries a grammar, the
+        masked program families are warmed too (``warmup(masks=True)``)
+        — a recovery swap is about to resubmit that constrained
+        request, and its masked-decode compile landing mid-iteration on
+        the fresh engine would stall the very heartbeat the watchdog
+        judges (the false-hang churn warmup exists to prevent).
+        Unconstrained rebuilds keep skipping the ~2x masked warm-up."""
         warmup = getattr(eng, "warmup", None)  # stub engines: no-op
         if warmup is None:
             return
+        with self._lock:
+            masks = any(t.kwargs.get("grammar") is not None
+                        for t in self._tracked.values())
         try:
-            warmup()
+            # the masks kwarg only when needed: stub/legacy engines in
+            # the chaos drills expose a zero-arg warmup()
+            warmup(masks=True) if masks else warmup()
         except Exception as e:
             self.tracer.instant("warmup_skipped", track="supervisor",
                                 args={"error": type(e).__name__,
@@ -590,6 +603,17 @@ class EngineSupervisor:
     def _untrack(self, request_id: str) -> None:
         with self._lock:
             self._tracked.pop(request_id, None)
+
+    def untrack(self, request_id: str) -> None:
+        """Public untrack for callers that drive a `submit()` handle
+        themselves instead of blocking in `generate_handle` — the SSE
+        streaming path: the HTTP tier drains the handle's TokenStream
+        and must drop the recovery-tracking entry when the stream ends
+        (completed or client-disconnected), exactly like
+        `generate_handle`'s finally does. Until then the request IS
+        tracked: an engine crash mid-stream resubmits it and the
+        token-identical re-decode resumes the stream seamlessly."""
+        self._untrack(request_id)
 
     def _prune_done(self) -> None:
         """Drop finished requests nobody untracked (fire-and-forget

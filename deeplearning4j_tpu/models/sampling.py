@@ -15,13 +15,29 @@ import numpy as np
 
 def _sample_logits(probs: np.ndarray, temperature: float, top_k: Optional[int],
                    rng: np.random.Generator,
-                   top_p: Optional[float] = None) -> int:
+                   top_p: Optional[float] = None,
+                   allow: Optional[np.ndarray] = None) -> int:
     """Pick a token id from one probability row [V]. ``top_p`` (nucleus
     sampling) keeps the smallest set of tokens whose cumulative probability
-    reaches p; composes with top_k (both filters apply)."""
+    reaches p; composes with top_k (both filters apply).
+
+    ``allow`` (bool [V], grammar-constrained decoding —
+    `inference/logitproc.py`): forbidden tokens get ``-inf`` logits, so
+    their sampling probability is EXACTLY zero (``exp(-inf) == 0``; one
+    `rng.choice` draw either way, so the RNG stream stays in lockstep
+    with unconstrained decode). An all-True mask leaves every value
+    untouched — an admit-everything grammar is token-identical to
+    ``allow=None`` by construction. The caller guarantees at least one
+    allowed token (the engine finishes a grammar-exhausted request
+    before sampling)."""
     if temperature <= 0.0:  # greedy
+        if allow is not None:
+            # probs are softmax outputs (>= 0): -1 can never win argmax
+            return int(np.where(allow, probs, -1.0).argmax())
         return int(probs.argmax())
     logits = np.log(np.maximum(probs, 1e-30)) / temperature
+    if allow is not None:
+        logits = np.where(allow, logits, -np.inf)
     if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
         cutoff = np.partition(logits, -top_k)[-top_k]
         logits = np.where(logits >= cutoff, logits, -np.inf)

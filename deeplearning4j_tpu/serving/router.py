@@ -462,6 +462,18 @@ class FleetRouter:
             "router_journal_replayed_total",
             help="journaled in-flight requests re-executed after a "
                  "router restart")
+        self._m_stream_reqs = m.counter(
+            "router_stream_requests_total",
+            help="/generate stream=true requests proxied as SSE "
+                 "pass-through")
+        self._m_stream_disconnects = m.counter(
+            "router_stream_disconnects_total",
+            help="SSE clients that hung up mid-stream at the router "
+                 "(upstream replica connection torn down -> its "
+                 "cancel-on-disconnect reclaims the slot). Namespaced "
+                 "router_*: the replica the hangup cascades to counts "
+                 "its own stream_disconnects_total — one client hangup "
+                 "is one tick at EACH tier, never summed")
 
     @property
     def port(self) -> int:
@@ -514,6 +526,24 @@ class FleetRouter:
             return self._admission
 
     # -- dispatch ----------------------------------------------------------
+    def _next_candidate(self, key: bytes, tried: set,
+                        deadline: float) -> Optional[Tuple[str, str]]:
+        """The next untried (name, url) by rendezvous rank over the
+        READY replicas, with one probe-lag grace poll when none are
+        visible yet (probes may trail a restart by a cycle). The ONE
+        candidate-selection policy shared by buffered dispatch, journal
+        replay, and the SSE stream pump — so the failover loops cannot
+        drift apart. None = nobody left to try."""
+        cands = [c for c in self.supervisor.ready_replicas()
+                 if c[0] not in tried]
+        if not cands and time.monotonic() < deadline:
+            time.sleep(0.05)
+            cands = [c for c in self.supervisor.ready_replicas()
+                     if c[0] not in tried]
+        if not cands:
+            return None
+        return pick_replica(key, cands)
+
     def _forward(self, url: str, path: str, body: bytes,
                  headers: Dict[str, str], timeout: float) -> dict:
         req = urllib.request.Request(
@@ -521,6 +551,26 @@ class FleetRouter:
             headers={"Content-Type": "application/json", **headers})
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.loads(resp.read().decode())
+
+    @staticmethod
+    def _raise_for_status(name: str, e: urllib.error.HTTPError) -> None:
+        """THE replica-error classification ladder, shared by buffered
+        dispatch and the SSE stream pump (two inline copies would
+        silently drift): 503 → :class:`_Replica503` (propagate
+        unchanged, Retry-After preserved), 504 → :class:`_DispatchTimeout`
+        (terminal — the request's budget is spent), other 4xx →
+        :class:`_ReplicaClientError` (terminal — the payload is the
+        problem), 5xx → plain return (the replica is sick; the caller
+        fails over). Drains and closes ``e`` either way."""
+        hdrs = dict(e.headers.items()) if e.headers else {}
+        detail = e.read()
+        e.close()
+        if e.code == 503:
+            raise _Replica503(name, detail, hdrs)
+        if e.code == 504:
+            raise _DispatchTimeout(name, detail)
+        if e.code < 500:
+            raise _ReplicaClientError(name, e.code, detail)
 
     def _dispatch(self, rid: str, payload: dict, path: str = "/generate",
                   ctx: Optional[TraceContext] = None,
@@ -545,17 +595,10 @@ class FleetRouter:
         tried: set = set()
         last_err: Optional[BaseException] = None
         for attempt in range(self.dispatch_attempts):
-            cands = [c for c in self.supervisor.ready_replicas()
-                     if c[0] not in tried]
-            if not cands and time.monotonic() < deadline:
-                # probes may lag a restart by a cycle: give the fleet a
-                # beat to report a ready replica before giving up
-                time.sleep(0.05)
-                cands = [c for c in self.supervisor.ready_replicas()
-                         if c[0] not in tried]
-            if not cands:
+            cand = self._next_candidate(key, tried, deadline)
+            if cand is None:
                 break
-            name, url = pick_replica(key, cands)
+            name, url = cand
             tried.add(name)
             if attempt:
                 self._m_retries.inc()
@@ -566,19 +609,7 @@ class FleetRouter:
                 return name, attempt + 1, self._forward(
                     url, path, body, headers, timeout)
             except urllib.error.HTTPError as e:
-                hdrs = dict(e.headers.items()) if e.headers else {}
-                detail = e.read()
-                e.close()
-                if e.code == 503:
-                    raise _Replica503(name, detail, hdrs)
-                if e.code == 504:
-                    # the replica enforced the request's own deadline
-                    # (decode cancelled, slot reclaimed): terminal —
-                    # failing over would re-run a request whose budget
-                    # is already spent
-                    raise _DispatchTimeout(name, detail)
-                if e.code < 500:
-                    raise _ReplicaClientError(name, e.code, detail)
+                self._raise_for_status(name, e)
                 last_err = e  # 5xx: the replica is sick, fail over
             except (urllib.error.URLError, OSError, TimeoutError) as e:
                 if time.monotonic() >= deadline:
@@ -601,6 +632,12 @@ class FleetRouter:
                 # incarnation recovers them (at-least-once holds)
                 return
             rid, req = rec["rid"], rec.get("req") or {}
+            if req.get("stream"):
+                # a replayed stream has no client to stream to: re-run
+                # it BUFFERED so the terminal record (and the replica's
+                # prefix-cache publish) still lands — at-least-once is
+                # about effects, not transport
+                req = {k: v for k, v in req.items() if k != "stream"}
             self.tracer.instant("journal_replay", req=rid,
                                 args={"request_id": rid})
             while not self._stop_replay.is_set():
@@ -787,15 +824,28 @@ class FleetRouter:
                                           router.supervisor.replicas],
                              "request_id": rid}, 202, request_id=rid)
                     if url.path == "/generate":
-                        out, code, extra = router.handle_generate(
-                            rid, raw, ctx, timeout_ms)
-                        self._send(out, code, request_id=rid,
-                                   headers=extra)
-                        if code >= 400:
-                            # fast rejects and propagated errors are not
-                            # SLO samples (the same dilution argument as
-                            # the replica's own observe policy)
-                            slo_sample = False
+                        payload = json.loads(raw.decode())
+                        if payload.get("stream"):
+                            # SSE pass-through: the handler writes the
+                            # response itself (chunked as the replica
+                            # emits; failover only before the first
+                            # byte; journal terminal at stream end)
+                            outcome = router.handle_generate_stream(
+                                self, rid, payload, ctx, timeout_ms)
+                            if outcome != "ok":
+                                slo_sample = False
+                        else:
+                            out, code, extra = router.handle_generate(
+                                rid, raw, ctx, timeout_ms,
+                                payload=payload)
+                            self._send(out, code, request_id=rid,
+                                       headers=extra)
+                            if code >= 400:
+                                # fast rejects and propagated errors are
+                                # not SLO samples (the same dilution
+                                # argument as the replica's own observe
+                                # policy)
+                                slo_sample = False
                     elif url.path in ("/predict", "/predict/csv"):
                         out, code, extra = router.handle_predict(
                             rid, url.path, raw, ctx, timeout_ms)
@@ -847,9 +897,14 @@ class FleetRouter:
     # -- request handling (thread-per-request via ThreadingHTTPServer) ----
     def handle_generate(self, rid: str, raw: bytes,
                         ctx: Optional[TraceContext],
-                        timeout_ms: Optional[float]):
-        """(body, status, extra_headers) for POST /generate."""
-        payload = json.loads(raw.decode())
+                        timeout_ms: Optional[float],
+                        payload: Optional[dict] = None):
+        """(body, status, extra_headers) for POST /generate.
+        ``payload``: the already-parsed body when the caller peeked at
+        it (do_POST reads the stream flag) — avoids a second
+        O(body) json.loads on the routing hot path."""
+        if payload is None:
+            payload = json.loads(raw.decode())
         if not isinstance(payload.get("prompt"), list):
             return ({"error": "prompt must be a list of token ids",
                      "request_id": rid}, 400, None)
@@ -935,6 +990,291 @@ class FleetRouter:
         resp["router"] = {"replica": name, "attempts": attempts,
                           "request_id": rid}
         return resp, 200, None
+
+    def handle_generate_stream(self, handler, rid: str, payload: dict,
+                               ctx: Optional[TraceContext],
+                               timeout_ms: Optional[float]) -> str:
+        """POST /generate ``{"stream": true}`` — SSE pass-through.
+
+        Same admission/affinity/journal discipline as buffered
+        `handle_generate`, but the replica's event stream is forwarded
+        chunk-by-chunk as it arrives instead of being buffered and
+        re-serialized. FAILOVER HAPPENS ONLY BEFORE THE FIRST BODY BYTE:
+        a replica that refuses the connection or 5xxes pre-stream is
+        retried on the next rendezvous candidate exactly like buffered
+        dispatch; once any byte has been forwarded the stream is
+        committed to that replica — a mid-stream replica death truncates
+        the client's stream (journaled ``fail``, the client re-submits),
+        because silently re-running the request elsewhere would replay
+        already-delivered tokens into the same stream.
+
+        The journal's terminal record is written AT STREAM END: clean
+        EOF → ``finish`` (with the terminal SSE event's token list when
+        parseable), client hangup → ``fail`` (closing the upstream
+        socket fires the replica's own cancel-on-disconnect, so the
+        slot is reclaimed fleet-wide), mid-stream replica death →
+        ``fail``. Exactly one terminal per accept, dedup'd by the
+        journal. Returns "ok" | "disconnect" | "rejected" | "truncated"
+        (only "ok" is an SLO sample)."""
+        if not isinstance(payload.get("prompt"), list):
+            # validated BEFORE the journal accept, like the buffered
+            # path: an accept with no possible terminal record would
+            # wedge cursor advancement and be falsely replayed
+            handler._send({"error": "prompt must be a list of token "
+                           "ids", "request_id": rid}, 400,
+                          request_id=rid)
+            return "rejected"
+        verdict = self.admission_verdict()
+        if self.admission_burn and verdict["burning"]:
+            self._m_rejected.inc()
+            self.tracer.instant("reject", track="router", args={
+                "request_id": rid, "reason": "fleet_burning"})
+            handler._send(
+                {"error": "fleet_burning",
+                 "burn_rate_fast": verdict["fast"],
+                 "burn_rate_slow": verdict["slow"],
+                 "retry_after_s": self.retry_after_s,
+                 "request_id": rid}, 503, request_id=rid,
+                headers={"Retry-After":
+                         str(max(1, int(self.retry_after_s)))})
+            return "rejected"
+        failpoints.fire("router.journal")
+        if self.journal is not None:
+            self.journal.accept(rid, payload)
+        self._m_stream_reqs.inc()
+        try:
+            # EVERYTHING past the accept sits inside the journaling
+            # contract, exactly like buffered handle_generate: any
+            # escape (injected fault, malformed token id in
+            # affinity_key, ...) still answers the client an error via
+            # do_POST, so it must leave a terminal record too
+            return self._dispatch_stream(handler, rid, payload, ctx,
+                                         timeout_ms)
+        except BaseException as e:
+            if self.journal is not None:
+                self.journal.fail(rid, f"dispatch error: {e!r}",
+                                  status=500)
+            raise
+
+    def _dispatch_stream(self, handler, rid: str, payload: dict,
+                         ctx: Optional[TraceContext],
+                         timeout_ms: Optional[float]) -> str:
+        """The SSE dispatch loop proper (journal accept already
+        written; the caller owns the journal-on-escape contract).
+        Candidate selection and replica-error classification are the
+        SAME `_next_candidate` / `_raise_for_status` the buffered path
+        uses — only the answer transport differs."""
+        body = json.dumps(payload).encode()
+        key = affinity_key(payload.get("prompt") or [], self.kv_block,
+                           self.affinity_blocks)
+        egress = (ctx.child() if ctx is not None else
+                  TraceContext(rid, span_id(rid, 0), 0, time.time()))
+        headers = {TRACE_HEADER: format_trace_header(egress),
+                   "X-Request-Id": rid,
+                   "Content-Type": "application/json"}
+        path = ("/generate" + (f"?timeout_ms={timeout_ms:g}"
+                               if timeout_ms else ""))
+        deadline = time.monotonic() + (timeout_ms / 1e3 if timeout_ms
+                                       else self.dispatch_timeout_s)
+        failpoints.fire("router.dispatch")
+        tried: set = set()
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.dispatch_attempts):
+            cand = self._next_candidate(key, tried, deadline)
+            if cand is None:
+                break
+            name, url = cand
+            tried.add(name)
+            if attempt:
+                self._m_retries.inc()
+            self.tracer.instant("route", req=rid, args={
+                "request_id": rid, "replica": name, "attempt": attempt,
+                "stream": True})
+            try:
+                req = urllib.request.Request(
+                    url + path, data=body, headers=headers)
+                resp = urllib.request.urlopen(
+                    req, timeout=max(0.05,
+                                     deadline - time.monotonic()))
+            except urllib.error.HTTPError as e:
+                try:
+                    self._raise_for_status(name, e)
+                    last_err = e  # 5xx pre-stream: fail over
+                    continue
+                except _Replica503 as exc:
+                    # the replica's own admission verdict: propagated
+                    # unchanged, Retry-After preserved, terminal
+                    self._m_propagated.inc()
+                    if self.journal is not None:
+                        self.journal.fail(rid, f"replica {name} 503",
+                                          status=503)
+                    handler._send(
+                        exc.body_bytes(), 503, request_id=rid,
+                        headers=({"Retry-After":
+                                  exc.headers["Retry-After"]}
+                                 if "Retry-After" in exc.headers
+                                 else None))
+                    return "rejected"
+                except _DispatchTimeout as exc:
+                    # terminal — the request's budget is spent (same
+                    # error counter + reject instant as buffered: a
+                    # streamed timeout must not vanish from
+                    # router_errors_total)
+                    self._m_err.inc()
+                    self.tracer.instant("reject", track="router", args={
+                        "request_id": rid, "reason": "timeout_504"})
+                    if self.journal is not None:
+                        self.journal.fail(rid, f"replica {name} 504",
+                                          status=504)
+                    handler._send(exc.body_bytes(rid), 504,
+                                  request_id=rid)
+                    return "rejected"
+                except _ReplicaClientError as exc:
+                    # terminal — no other replica will like the payload
+                    if self.journal is not None:
+                        self.journal.fail(
+                            rid, f"replica {name} {exc.status}",
+                            status=exc.status)
+                    handler._send(exc.body_bytes(), exc.status,
+                                  request_id=rid)
+                    return "rejected"
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                if time.monotonic() >= deadline:
+                    self._m_err.inc()
+                    self.tracer.instant("reject", track="router", args={
+                        "request_id": rid, "reason": "timeout_504"})
+                    if self.journal is not None:
+                        self.journal.fail(
+                            rid, "deadline exceeded pre-stream",
+                            status=504)
+                    handler._send(
+                        {"error": "deadline exceeded at the router",
+                         "request_id": rid}, 504, request_id=rid)
+                    return "rejected"
+                last_err = e  # connection refused/reset: failover
+                continue
+            outcome = self._pump_stream(handler, rid, name, resp)
+            if outcome == "failover":
+                last_err = RuntimeError(
+                    f"replica {name} died before its first stream byte")
+                continue
+            return outcome
+        self._m_err.inc()
+        if self.journal is not None:
+            self.journal.fail(rid, repr(last_err), status=502)
+        handler._send({"error": "no_replica", "detail": repr(last_err),
+                       "request_id": rid}, 502, request_id=rid)
+        return "rejected"
+
+    def _pump_stream(self, handler, rid: str, name: str, resp) -> str:
+        """Forward one replica's SSE body to the client as it arrives.
+        Returns "ok" (clean EOF, journaled finish), "disconnect" (the
+        CLIENT hung up — upstream closed so the replica cancels),
+        "truncated" (the replica died mid-stream after bytes were
+        forwarded), or "failover" (upstream died before its first byte
+        AND nothing was sent — the caller retries elsewhere; the
+        client's response is untouched)."""
+        sent = 0
+        tail = b""
+        started = False
+        try:
+            try:
+                while True:
+                    try:
+                        # read1: returns as soon as ANY bytes are
+                        # available — a full read(n) would buffer the
+                        # very tokens streaming exists to deliver early
+                        chunk = resp.read1(8192)
+                    except (OSError, ValueError) as e:
+                        if not started:
+                            return "failover"
+                        self._m_err.inc()
+                        if self.journal is not None:
+                            self.journal.fail(
+                                rid, f"replica {name} died mid-stream: "
+                                f"{e!r}", status=502)
+                        return "truncated"
+                    if not chunk:
+                        break  # EOF — clean only if the terminal event
+                        # arrived (checked below: a SIGKILLed replica's
+                        # FIN reads as EOF too, because SSE bodies are
+                        # close-delimited, not length-framed)
+                    if not started:
+                        started = True
+                        handler.send_response(200)
+                        handler.send_header(
+                            "Content-Type",
+                            resp.headers.get("Content-Type",
+                                             "text/event-stream"))
+                        handler.send_header("Cache-Control", "no-cache")
+                        handler.send_header("X-Request-Id", rid)
+                        handler.end_headers()
+                    # keep a bounded tail so the terminal event's token
+                    # list can land in the journal without buffering
+                    # the whole stream. Trim at EVENT boundaries: a
+                    # blind byte cap would slice the `data: ` prefix
+                    # off a terminal event larger than the cap and
+                    # misread a cleanly finished long completion as
+                    # truncated — so the tail always holds the current
+                    # (last) event whole, shedding only earlier ones
+                    tail += chunk
+                    if len(tail) > 65536:
+                        cut = tail.rfind(b"data: ")
+                        if cut > 0:
+                            tail = tail[cut:]
+                    handler.wfile.write(chunk)
+                    handler.wfile.flush()
+                    sent += len(chunk)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # the CLIENT hung up mid-stream: the finally's
+                # resp.close() tears down the replica socket, firing
+                # the replica's own cancel-on-disconnect — the slot is
+                # reclaimed fleet-wide, and the journal records the
+                # terminal exactly once
+                self._m_stream_disconnects.inc()
+                self.tracer.instant(
+                    "stream_disconnect", req=rid,
+                    args={"request_id": rid, "replica": name,
+                          "bytes": sent})
+                if self.journal is not None:
+                    self.journal.fail(
+                        rid, "client disconnected mid-stream",
+                        status=499)
+                return "disconnect"
+        finally:
+            try:
+                resp.close()
+            except OSError:
+                pass
+        # EOF is only a CLEAN end when the terminal SSE event arrived:
+        # SSE bodies are close-delimited, so a replica SIGKILLed
+        # mid-stream produces the same zero-byte read as a finished one
+        # — journaling that as "finish" would silently drop the request
+        # from replay (and, pre-first-byte, answer the client nothing)
+        tokens = None
+        saw_done = False
+        for line in tail.decode("utf-8", "replace").splitlines():
+            if not line.startswith("data: "):
+                continue
+            try:
+                evt = json.loads(line[len("data: "):])
+            except ValueError:
+                continue  # torn tail line; keep scanning
+            if evt.get("done"):
+                saw_done = True
+                tokens = evt.get("tokens")
+        if not saw_done:
+            if not started:
+                return "failover"  # died before any byte: retry elsewhere
+            self._m_err.inc()
+            if self.journal is not None:
+                self.journal.fail(
+                    rid, f"replica {name} stream ended without a "
+                    "terminal event", status=502)
+            return "truncated"
+        if self.journal is not None:
+            self.journal.finish(rid, tokens=tokens, replica=name)
+        return "ok"
 
     def handle_predict(self, rid: str, path: str, raw: bytes,
                        ctx: Optional[TraceContext],

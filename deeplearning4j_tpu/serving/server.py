@@ -115,14 +115,28 @@ Endpoints:
   POST /predict/csv       text/plain CSV rows     -> same, via the
                           RecordToDataSetConverter (label column ignored)
   POST /generate          {"prompt": [ids], "max_new_tokens": N,
-                          "temperature"/"top_k"/"top_p"/"seed"/"eos_id"?}
+                          "temperature"/"top_k"/"top_p"/"seed"/"eos_id"?,
+                          "stop"/"repetition_penalty"/"presence_penalty"
+                          /"frequency_penalty"/"grammar"?}
                           -> {"tokens": [ids], "request_id": "...",
+                          "finish_reason": "length|eos|stop|grammar",
                           "timings": {queue_ms, restore_ms, prefill_ms,
                           decode_ms, total_ms}}; 400 unless the server
                           was started with decode_vocab. A ?timeout_ms
                           expiry CANCELS the decode (slot reclaimed) ->
                           HTTP 504; a full decode queue -> HTTP 503; a
-                          prompt that cannot fit the KV cache -> HTTP 413
+                          prompt that cannot fit the KV cache -> HTTP 413.
+                          {"stream": true} -> 200 text/event-stream: one
+                          `data: {"token", "index"}` event per decoded
+                          token, then `data: {"done": true, request_id,
+                          tokens, finish_reason, timings}`; a client
+                          hangup mid-stream cancels the decode (slot +
+                          pins reclaimed, stream_disconnects_total).
+                          "grammar" ({"type": "admit_all" | "trie" |
+                          "json_schema", ...}) compiles ahead of
+                          admission to device token masks — see
+                          docs/serving.md "Streaming & constrained
+                          decoding"
   POST /admin/drain       draining restart: stop admitting, finish
                           in-flight, swap the engine, resume (202; watch
                           /readyz flip)
@@ -132,8 +146,11 @@ Endpoints:
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import re
+import select
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -144,10 +161,13 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from ..inference import (AdmissionRejectedError, DecodeScheduler,
-                         EngineSupervisor, MetricsRegistry, MicroBatcher,
+                         EngineSupervisor, GrammarError, MetricsRegistry,
+                         MicroBatcher,
                          PromptTooLongError, QueueFullError,
                          RequestTimeoutError, RetryBudgetExceededError,
-                         SLOMonitor, ShuttingDownError, failpoints)
+                         SLOMonitor, ShuttingDownError, TokenStream,
+                         admit_all, compile_json_schema, compile_trie,
+                         failpoints)
 from ..inference.failpoints import InjectedFault
 from ..inference.trace import FlightRecorder, new_request_id
 from .streaming import RecordToDataSetConverter
@@ -161,6 +181,25 @@ from .telemetry import TRACE_HEADER, parse_trace_header
 # server-generated id instead.
 _REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._:\-]{1,128}")
 
+# bounded grammar-compile cache: compiled AHEAD of admission, shared
+# across requests carrying byte-equal grammar specs
+_GRAMMAR_CACHE_CAP = 32
+
+
+def _peer_gone(sock) -> bool:
+    """True when the SSE client hung up: the socket is readable and a
+    zero-byte MSG_PEEK confirms EOF (an orderly close; an RST raises
+    OSError, also caught). Polled between events so a silent disconnect
+    is noticed promptly even when the kernel send buffer would have
+    absorbed the next token write without raising EPIPE."""
+    try:
+        readable, _, _ = select.select([sock], [], [], 0)
+        if not readable:
+            return False
+        return sock.recv(1, socket.MSG_PEEK) == b""
+    except (OSError, ValueError):
+        return True
+
 
 class InferenceServer:
     def __init__(self, net=None, model_path: Union[str, Path, None] = None,
@@ -173,6 +212,7 @@ class InferenceServer:
                  prefill_chunk: int = 64, decode_queue: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
                  kv_pool_mb: float = 0.0, kv_dtype: Optional[str] = None,
+                 mask_rows: int = 64,
                  decode_tp: int = 0, speculate: int = 0,
                  draft_blocks: int = 0, draft_net=None,
                  metrics: Optional[MetricsRegistry] = None,
@@ -206,6 +246,12 @@ class InferenceServer:
         self.kv_block = int(kv_block)
         self.kv_pool_mb = float(kv_pool_mb)
         self.kv_dtype = kv_dtype
+        # grammar-constrained decoding (ISSUE 14): device mask-table
+        # rows; grammar specs in /generate payloads compile ONCE (cache
+        # below, keyed by spec bytes) ahead of admission
+        self.mask_rows = int(mask_rows)
+        self._grammar_cache: Dict[str, object] = {}
+        self._grammar_lock = threading.Lock()
         # speculative decoding (ISSUE 10): gamma draft tokens per slot
         # per iteration, verified token-identically by one multi-token
         # target forward; draft = shallow exit over the first
@@ -264,6 +310,19 @@ class InferenceServer:
         self._batchers: Dict[Tuple, MicroBatcher] = {}
         self._batchers_lock = threading.Lock()
         self.max_signatures = 16
+        # streaming observability (ISSUE 14): request/disconnect
+        # counters live on the server (the engine owns the TTFT
+        # histogram + first_token instant)
+        self._m_stream_reqs = self.metrics.counter(
+            "stream_requests_total",
+            help="/generate requests served as SSE token streams")
+        self._m_stream_disconnects = self.metrics.counter(
+            "stream_disconnects_total",
+            help="SSE clients that hung up mid-stream (decode "
+                 "cancelled, slot reclaimed)")
+        self._m_grammar_compiles = self.metrics.counter(
+            "grammar_compiles_total",
+            help="grammar specs compiled (cache misses)")
 
     @property
     def port(self) -> int:
@@ -287,6 +346,7 @@ class InferenceServer:
             kv_block=self.kv_block,
             kv_pool_mb=self.kv_pool_mb,
             kv_dtype=self.kv_dtype,
+            mask_rows=self.mask_rows,
             mesh=self.decode_tp if self.decode_tp > 1 else None,
             speculate=self.speculate,
             draft_blocks=self.draft_blocks or None,
@@ -358,6 +418,83 @@ class InferenceServer:
             if out.ndim >= 2 and out.shape[-1] > 0 else [],
         }
 
+    def _compile_grammar(self, spec: dict, eos_id: Optional[int]):
+        """Compile a /generate ``grammar`` spec (AHEAD of admission —
+        the tentpole contract: mask construction never rides the decode
+        hot path), cached by spec bytes so a repeated structured-output
+        schema compiles once for the whole serving lifetime.
+
+        Spec forms: ``{"type": "admit_all"}`` (the token-identity
+        reference), ``{"type": "trie", "sequences": [[ids], ...]}``
+        (emit exactly one of the sequences), ``{"type": "json_schema",
+        "schema": {...}, "alphabet": "chars-or-token-strings"}`` (the
+        alphabet maps token id -> decoded text; see
+        logitproc.compile_json_schema for the schema subset)."""
+        if not isinstance(spec, dict):
+            raise GrammarError("grammar must be an object")
+        # digest, not the serialized spec itself: a json_schema spec
+        # carries a vocab-length alphabet, and retaining up to 32 full
+        # spec strings as dict keys would hold O(32 x vocab) bytes
+        # forever (the one canonicalization pass per request stays —
+        # content addressing has to read the content)
+        key = hashlib.sha1(json.dumps([spec, eos_id],
+                                      sort_keys=True).encode()).hexdigest()
+        with self._grammar_lock:
+            g = self._grammar_cache.get(key)
+        if g is not None:
+            return g
+        typ = spec.get("type")
+        if typ == "admit_all":
+            g = admit_all(self.decode_vocab)
+        elif typ == "trie":
+            g = compile_trie(spec.get("sequences") or [],
+                             self.decode_vocab, eos_id=eos_id)
+        elif typ == "json_schema":
+            alphabet = spec.get("alphabet")
+            if alphabet is None:
+                raise GrammarError(
+                    "json_schema grammar needs an 'alphabet' (token id "
+                    "-> decoded text)")
+            if len(alphabet) != self.decode_vocab:
+                raise GrammarError(
+                    f"alphabet length {len(alphabet)} != vocab "
+                    f"{self.decode_vocab}")
+            g = compile_json_schema(spec.get("schema") or {}, alphabet,
+                                    eos_id=eos_id)
+        else:
+            raise GrammarError(
+                f"unknown grammar type {typ!r} (admit_all | trie | "
+                "json_schema)")
+        self._m_grammar_compiles.inc()
+        with self._grammar_lock:
+            if len(self._grammar_cache) >= _GRAMMAR_CACHE_CAP:
+                # bounded: drop the oldest entry (insertion order) — a
+                # client cycling unique schemas cannot grow this
+                self._grammar_cache.pop(next(iter(self._grammar_cache)))
+            self._grammar_cache[key] = g
+        return g
+
+    def _decode_kwargs(self, payload: dict) -> dict:
+        """The per-request decode kwargs shared by the buffered and
+        streaming /generate paths: sampling knobs plus the ISSUE 14
+        logit-pipeline spec (stop sequences, penalties, grammar)."""
+        kw = {k: payload[k] for k in ("temperature", "top_k", "top_p",
+                                      "seed", "eos_id", "priority",
+                                      "repetition_penalty",
+                                      "presence_penalty",
+                                      "frequency_penalty")
+              if k in payload}
+        stop = payload.get("stop")
+        if stop:
+            if isinstance(stop[0], (int, float)):
+                stop = [stop]  # one bare sequence
+            kw["stop"] = [[int(t) for t in s] for s in stop]
+        gspec = payload.get("grammar")
+        if gspec is not None:
+            kw["grammar"] = self._compile_grammar(gspec,
+                                                  payload.get("eos_id"))
+        return kw
+
     def _generate(self, payload: dict, timeout_ms: Optional[float],
                   request_id: Optional[str] = None) -> dict:
         gen = (self.supervisor if self.supervisor is not None
@@ -367,9 +504,7 @@ class InferenceServer:
                              "with decode_vocab (CLI: --generate)")
         if timeout_ms is None:
             timeout_ms = self.default_timeout_ms
-        kw = {k: payload[k] for k in ("temperature", "top_k", "top_p",
-                                      "seed", "eos_id", "priority")
-              if k in payload}
+        kw = self._decode_kwargs(payload)
         prompt = [int(t) for t in payload["prompt"]]
         max_new = int(payload.get("max_new_tokens", 16))
         timeout = timeout_ms / 1e3 if timeout_ms is not None else 120.0
@@ -412,9 +547,115 @@ class InferenceServer:
         # whose four segments sum to the end-to-end latency
         out = {"tokens": handle.tokens, "request_id": handle.request_id,
                "timings": handle.timings()}
+        if handle.finish_reason:
+            out["finish_reason"] = handle.finish_reason
         if handle.retries:
             out["retries"] = handle.retries  # survived engine crash(es)
         return out
+
+    def _generate_stream(self, handler, payload: dict,
+                         timeout_ms: Optional[float], rid: str) -> str:
+        """POST /generate with ``"stream": true`` — SSE token emission.
+
+        Writes the response DIRECTLY on ``handler``: one
+        ``data: {"token": t, "index": i}`` event per decoded token as
+        the scheduler releases it (stop-sequence hold-back applies —
+        a client never sees half a stop sequence), then a terminal
+        ``data: {"done": true, request_id, tokens, finish_reason,
+        timings}`` event. Submit-time failures (413/503/400) raise
+        BEFORE any byte is written, so do_POST's ordinary error mapping
+        answers them as JSON; once the SSE headers are out, failures are
+        reported in-band on a best-effort final event.
+
+        Client disconnects are detected between events (socket EOF
+        peek) and on write (EPIPE): the decode is CANCELLED — the slot,
+        its paged blocks, the prefix-trie pin, any fork membership, and
+        the grammar mask rows are all reclaimed at the scheduler's next
+        sweep — and ``stream_disconnects_total`` counts it. Returns
+        "ok" | "disconnect" (the SLO plane skips disconnects: the
+        client, not the server, ended those)."""
+        gen = (self.supervisor if self.supervisor is not None
+               else self._decoder_direct)
+        if gen is None:
+            raise ValueError("generation is disabled: start the server "
+                             "with decode_vocab (CLI: --generate)")
+        if int(payload.get("n", 1)) != 1:
+            raise ValueError("stream=true supports n=1 only (best-of-n "
+                             "candidates finish at different times; "
+                             "rank buffered candidates instead)")
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        timeout = timeout_ms / 1e3 if timeout_ms is not None else 120.0
+        kw = self._decode_kwargs(payload)
+        prompt = [int(t) for t in payload["prompt"]]
+        max_new = int(payload.get("max_new_tokens", 16))
+        stream = TokenStream()
+        # everything above (parse errors, grammar compile errors, 413s,
+        # queue-full 503s from this submit) raises pre-header: the
+        # client gets the same structured JSON errors as buffered mode
+        handle = gen.submit(prompt, max_new, request_id=rid,
+                            stream=stream, **kw)
+        self._m_stream_reqs.inc()
+        status = "ok"
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/event-stream")
+            handler.send_header("Cache-Control", "no-cache")
+            handler.send_header("X-Request-Id", rid)
+            handler.end_headers()
+            deadline = time.monotonic() + timeout
+            conn = handler.connection
+            try:
+                for evt in stream.events(deadline=deadline):
+                    if _peer_gone(conn):
+                        raise BrokenPipeError("SSE client hung up")
+                    handler.wfile.write(
+                        b"data: " + json.dumps(evt).encode() + b"\n\n")
+                    handler.wfile.flush()
+            except TimeoutError:
+                # the request's own deadline (buffered mode's 504):
+                # cancel reclaims the slot; the expiry is reported
+                # in-band — headers are long gone — but it still counts
+                # in http_errors_total exactly like a buffered 504
+                handle.cancel()
+                self.metrics.counter("http_errors_total").inc()
+                self.tracer.instant("reject", track="http", args={
+                    "request_id": rid, "reason": "stream_timeout"})
+                handler.wfile.write(
+                    b"data: " + json.dumps(
+                        {"done": True, "request_id": rid,
+                         "error": "deadline exceeded",
+                         "finish_reason": "timeout"}).encode() + b"\n\n")
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # cancel-on-disconnect: the slot (and every pin riding it)
+            # is reclaimed at the scheduler's next sweep instead of
+            # decoding to max_new_tokens for a client that left
+            status = "disconnect"
+            handle.cancel()
+            self._m_stream_disconnects.inc()
+            self.tracer.instant(
+                "stream_disconnect", req=rid,
+                args={"request_id": rid, "streamed": stream.sent})
+        except Exception as e:  # post-header: report in-band, never a
+            # second status line into the event stream
+            handle.cancel()
+            try:
+                handler.wfile.write(
+                    b"data: " + json.dumps(
+                        {"done": True, "request_id": rid,
+                         "error": str(e)}).encode() + b"\n\n")
+                handler.wfile.flush()
+            except OSError:
+                status = "disconnect"
+        finally:
+            if self.supervisor is not None:
+                # leave the crash-recovery tracking set exactly like
+                # generate_handle's finally: a client that got its
+                # stream (or gave up) must not have the request
+                # replayed by a later engine restart
+                self.supervisor.untrack(rid)
+        return status
 
     def start(self) -> "InferenceServer":
         server = self
@@ -687,9 +928,23 @@ class InferenceServer:
                         self._send(server._predict(arr, timeout_ms),
                                    request_id=rid)
                     elif url.path == "/generate":
-                        self._send(server._generate(
-                            json.loads(raw.decode()), timeout_ms,
-                            request_id=rid), request_id=rid)
+                        payload = json.loads(raw.decode())
+                        if payload.get("stream"):
+                            # SSE: _generate_stream writes the response
+                            # itself; submit-time errors raise before
+                            # any byte and fall through to the JSON
+                            # error mapping below
+                            outcome = server._generate_stream(
+                                self, payload, timeout_ms, rid)
+                            if outcome == "disconnect":
+                                # the CLIENT ended this one: not an SLO
+                                # sample (same dilution argument as the
+                                # fast rejects)
+                                slo_sample = False
+                        else:
+                            self._send(server._generate(
+                                payload, timeout_ms,
+                                request_id=rid), request_id=rid)
                     else:
                         self._send({"error": "not found"}, 404,
                                    request_id=rid)

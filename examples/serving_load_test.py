@@ -145,6 +145,52 @@ def _post(port, path, body, retries=None, headers=None):
         time.sleep(delay)
 
 
+def _post_stream(port, path, body, headers=None):
+    """POST a ``stream=true`` /generate and consume the SSE response
+    (ISSUE 14). Returns the TERMINAL event dict augmented with the
+    client-observed ``ttft_ms`` (send -> first token event on the wire
+    — the real thing the server's `generate_first_token_seconds`
+    histogram approximates from inside) and ``client_ms``, plus the
+    per-event token list for the token-identity cross-check."""
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+    t0 = time.perf_counter()
+    conn.request("POST", path, body,
+                 {"Content-Type": "application/json", **(headers or {})})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        raise urllib.error.HTTPError(path, resp.status, resp.reason,
+                                     resp.headers, resp)
+    buf = b""
+    ttft = None
+    done = None
+    toks = []
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            line, buf = buf.split(b"\n\n", 1)
+            if not line.startswith(b"data: "):
+                continue
+            evt = json.loads(line[len(b"data: "):])
+            if evt.get("done"):
+                done = evt
+            elif "token" in evt:
+                if ttft is None:
+                    ttft = (time.perf_counter() - t0) * 1e3
+                toks.append(evt["token"])
+    conn.close()
+    if done is None:
+        raise RuntimeError(f"{path}: stream ended without a terminal "
+                           "event")
+    done["streamed_tokens"] = toks
+    done["client_ms"] = (time.perf_counter() - t0) * 1e3
+    done["ttft_ms"] = ttft if ttft is not None else done["client_ms"]
+    return done
+
+
 def summarize_timings(results):
     """Client-side SLO aggregation over the per-response ``timings``
     every `/generate` answer carries (ISSUE 11 satellite): end-to-end
@@ -174,6 +220,26 @@ def summarize_timings(results):
             "mean": round(sum(vals) / len(vals), 3),
             "p99": round(pct(vals, 0.99), 3),
             "share": round(sum(vals) / max(1e-9, sum(totals)), 4)}
+    # TTFT (ISSUE 14 satellite): client-measured when the run streamed
+    # (wall time to the first SSE token event), otherwise derived from
+    # the server timings (queue+restore+prefill ends exactly at the
+    # first token by construction)
+    ttfts = []
+    client_measured = False
+    for r in results:
+        if r.get("ttft_ms") is not None:
+            ttfts.append(r["ttft_ms"])
+            client_measured = True
+        elif r.get("timings"):
+            t = r["timings"]
+            ttfts.append(t.get("queue_ms", 0.0) + t.get("restore_ms", 0.0)
+                         + t.get("prefill_ms", 0.0))
+    if ttfts:
+        out["ttft_ms"] = {"p50": round(pct(ttfts, 0.50), 3),
+                          "p95": round(pct(ttfts, 0.95), 3),
+                          "p99": round(pct(ttfts, 0.99), 3),
+                          "source": ("client" if client_measured
+                                     else "server")}
     return out
 
 
@@ -188,6 +254,10 @@ def print_timing_table(summary):
     for ph, s in summary["phases"].items():
         print(f"  {ph:<10} {s['mean']:8.1f} {s['p99']:9.1f}   "
               f"{100 * s['share']:5.1f}%")
+    ttft = summary.get("ttft_ms")
+    if ttft:
+        print(f"  first_token ({ttft['source']}): p50 {ttft['p50']:.1f}ms"
+              f"  p95 {ttft['p95']:.1f}ms  p99 {ttft['p99']:.1f}ms")
 
 
 def _drive(server, n_threads, reqs_each, body):
@@ -226,7 +296,7 @@ def _make_lm(vocab=32, cache=96):
 
 
 def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
-                  trace_out=None, mesh=0, verbose=True):
+                  trace_out=None, mesh=0, stream=False, verbose=True):
     """Drive POST /generate and show where each request's time went.
     ``mesh`` > 1: tensor-parallel decode over that many devices, paged
     KV pool (per-device budget) instead of the contiguous prefix
@@ -257,7 +327,8 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
     # thread-safe); a few repeats so the prefix cache has something to hit
     bodies = [json.dumps(
         {"prompt": rng.integers(0, vocab, prompt_len).tolist(),
-         "max_new_tokens": new_tokens}).encode()
+         "max_new_tokens": new_tokens,
+         **({"stream": True} if stream else {})}).encode()
         for _ in range(max(1, n_threads * reqs_each // 2))]
 
     def client(k):
@@ -268,11 +339,18 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
             try:
                 ctx = ctracer.send("/generate")
                 t_send = time.perf_counter()
-                r = _post(srv.port, "/generate",
-                          bodies[(k * reqs_each + i) % len(bodies)],
-                          retries=retry_counts,
-                          headers=ctracer.headers(ctx))
-                r["client_ms"] = (time.perf_counter() - t_send) * 1e3
+                body = bodies[(k * reqs_each + i) % len(bodies)]
+                if stream:
+                    # SSE mode (ISSUE 14): consume the token events as
+                    # they arrive — ttft_ms is the real wire-level
+                    # time-to-first-token the phase table reports
+                    r = _post_stream(srv.port, "/generate", body,
+                                     headers=ctracer.headers(ctx))
+                else:
+                    r = _post(srv.port, "/generate", body,
+                              retries=retry_counts,
+                              headers=ctracer.headers(ctx))
+                    r["client_ms"] = (time.perf_counter() - t_send) * 1e3
                 ctracer.done(ctx, args={
                     "request_id": r.get("request_id"),
                     "client_ms": round(r["client_ms"], 3)})
@@ -324,6 +402,7 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
                       "is DISABLED (see the engine warning above); "
                       "single-device numbers follow")
         print(f"generate:   {len(results)} requests, {tok_s:8.1f} tokens/s"
+              + (" [SSE streamed]" if stream else "")
               + (f"  (HTTP retries: {sum(retry_counts)} across {retried} "
                  f"request(s), max {max(retry_counts)})"
                  if retried else ""))
@@ -550,6 +629,10 @@ if __name__ == "__main__":
                          "tensor-parallel over N devices (forces an "
                          "N-device virtual CPU mesh when needed) and "
                          "report tokens/s")
+    ap.add_argument("--stream", action="store_true",
+                    help="with --generate: request SSE token streams "
+                         "and report client-measured TTFT in the phase "
+                         "table")
     ap.add_argument("--fleet", type=int, default=0,
                     help="spawn a prefix-affine fleet router + N engine "
                          "replica PROCESSES and drive /generate through "
@@ -561,7 +644,8 @@ if __name__ == "__main__":
                    reqs_each=a.requests)
     elif a.generate:
         main_generate(n_threads=a.threads, reqs_each=a.requests,
-                      trace_out=a.trace_out, mesh=a.mesh)
+                      trace_out=a.trace_out, mesh=a.mesh,
+                      stream=a.stream)
     else:
         main(n_threads=a.threads, reqs_each=a.requests, rows=a.rows,
              compare=a.compare)

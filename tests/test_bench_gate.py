@@ -187,3 +187,36 @@ def test_fleet_router_hit_rate_dilution_is_caught():
                                 "lost_requests": 0,
                                 "outputs_identical": 1}}
     assert bench.check_floors(healthy) == []
+
+
+def test_constrained_stream_regressions_are_caught():
+    """ISSUE 14 acceptance floors: masked decode may cost at most ~10%
+    of unmasked step time (someone moving the mask apply off-device or
+    adding a per-token host sync would blow past that), an
+    admit-everything grammar must stay token-identical to unconstrained
+    decode (streamed == buffered included), and every schema-constrained
+    completion must parse — each break must trip the gate alone."""
+    slow = {"constrained_stream": {"step_time_ratio": 0.8,
+                                   "outputs_identical": 1,
+                                   "outputs_valid": 1}}
+    regs = bench.check_floors(slow)
+    assert any("step_time_ratio" in r for r in regs), regs
+
+    divergent = {"constrained_stream": {"step_time_ratio": 0.95,
+                                        "outputs_identical": 0,
+                                        "outputs_valid": 1}}
+    regs = bench.check_floors(divergent)
+    assert any("outputs_identical" in r for r in regs), regs
+
+    invalid = {"constrained_stream": {"step_time_ratio": 0.95,
+                                      "outputs_identical": 1,
+                                      "outputs_valid": 0}}
+    regs = bench.check_floors(invalid)
+    assert any("outputs_valid" in r for r in regs), regs
+
+
+def test_constrained_stream_healthy_row_passes():
+    healthy = {"constrained_stream": {"step_time_ratio": 0.94,
+                                      "outputs_identical": 1,
+                                      "outputs_valid": 1}}
+    assert bench.check_floors(healthy) == []

@@ -1,0 +1,760 @@
+"""Streaming generation + constrained decoding (ISSUE 14).
+
+The tentpole invariants:
+
+  - an admit-everything grammar is TOKEN-IDENTICAL to unconstrained
+    decode (greedy + seeded-sampled, contiguous/paged/tp2, speculation
+    armed — and speculative acceptance counters are unchanged);
+  - every completion under a JSON schema parses against it;
+  - streamed output == buffered output, token for token;
+  - a client dropping mid-stream frees the slot, releases trie pins,
+    and counts ``stream_disconnects_total`` (regression: raw-socket
+    hangup mid-decode);
+  - CompileCounter budgets hold (<= 1 masked-decode program per table
+    bucket, zero per-request recompiles).
+
+Plus units for the pure pieces: the Aho-Corasick stop matcher, the
+grammar compilers, the penalty pipeline, the exact allow-mask sampler,
+the mask-row pool, and the index-deduplicating token stream.
+"""
+import json
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis.runtime import CompileCounter
+from deeplearning4j_tpu.inference import (DecodeScheduler, GrammarError,
+                                          MetricsRegistry, TokenStream,
+                                          admit_all, compile_json_schema,
+                                          compile_trie)
+from deeplearning4j_tpu.inference.logitproc import (LogitState, MaskPool,
+                                                    StopMatcher)
+from deeplearning4j_tpu.inference.speculative import accept_tokens
+from deeplearning4j_tpu.models.sampling import sample_logits
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.serving import InferenceServer
+
+V = 29
+# token id -> decoded char for the JSON-schema tests (8 structural
+# chars + digits + letters = exactly V single-char tokens)
+ALPHABET = ('"{}:,[]-' + "0123456789" + "abcdefghijk")[:V]
+
+
+def _lm(cache=128, n_heads=4, seed=7):
+    conf = transformer_lm(vocab_size=V, d_model=32, n_heads=n_heads,
+                          n_blocks=2, rope=True, seed=seed)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = cache
+    return ComputationGraph(conf).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _lm()
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    return [int(t) for t in np.random.default_rng(3).integers(0, V, 24)]
+
+
+@pytest.fixture(scope="module")
+def base(net, prompt):
+    """The unconstrained reference run every identity test compares
+    against — computed once (tier-1 is wall-clock-budgeted)."""
+    h, _, _ = _run(net, prompt)
+    return h.tokens
+
+
+def _run(net, prompt, new_tokens=12, engine_kw=None, gen_kw=None):
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          metrics=m, transfer_guard="disallow",
+                          **(engine_kw or {})).start()
+    try:
+        h = eng.generate_handle(prompt, new_tokens, timeout=600,
+                                **(gen_kw or {}))
+    finally:
+        eng.stop()
+    return h, m, eng
+
+
+# -- stop matcher (pure) ----------------------------------------------------
+def test_stop_matcher_across_token_boundaries():
+    sm = StopMatcher([[5, 6, 7]])
+    assert sm.feed(5) == 0 and sm.pending == 1
+    assert sm.feed(6) == 0 and sm.pending == 2
+    assert sm.feed(7) == 3  # full match, length of the stop sequence
+
+
+def test_stop_matcher_partial_match_dies_and_releases():
+    sm = StopMatcher([[5, 6, 7]])
+    sm.feed(5)
+    sm.feed(6)
+    assert sm.pending == 2
+    assert sm.feed(9) == 0
+    assert sm.pending == 0  # withheld tokens are safe to release now
+
+
+def test_stop_matcher_overlapping_restart():
+    # stream 5 5 6: the failed [5,6] start at pos 0 must not eat the
+    # restart at pos 1 (fail links, not a reset)
+    sm = StopMatcher([[5, 6]])
+    assert sm.feed(5) == 0
+    assert sm.feed(5) == 0 and sm.pending == 1
+    assert sm.feed(6) == 2
+
+
+def test_stop_matcher_multiple_sequences_longest_wins():
+    sm = StopMatcher([[6, 7], [5, 6, 7]])
+    sm.feed(5)
+    sm.feed(6)
+    assert sm.feed(7) == 3  # both end here; the longest is reported
+
+
+def test_stop_matcher_rejects_empty():
+    with pytest.raises(ValueError):
+        StopMatcher([[]])
+
+
+# -- grammar compilers (pure) ----------------------------------------------
+def test_admit_all_mask_table_is_all_zeros():
+    g = admit_all(V)
+    assert g.n_states == 1 and g.allow.all()
+    assert (g.mask_table() == 0.0).all()
+
+
+def test_compile_trie_walk_and_completion():
+    g = compile_trie([[1, 2], [1, 3, 4]], V)
+    assert set(np.nonzero(g.allow[0])[0]) == {1}
+    s = g.step(0, 1)
+    assert set(np.nonzero(g.allow[s])[0]) == {2, 3}
+    s2 = g.step(s, 2)
+    assert not g.live(s2) and g.accepting[s2]  # complete: nothing more
+
+
+def test_compile_trie_eos_baked_into_accepting_states():
+    g = compile_trie([[1, 2]], V, eos_id=9)
+    s = g.step(g.step(0, 1), 2)
+    assert g.accepting[s]
+    assert set(np.nonzero(g.allow[s])[0]) == {9}
+
+
+def test_json_schema_uncoverable_literal_raises():
+    with pytest.raises(GrammarError):
+        compile_json_schema({"type": "boolean"}, ALPHABET)  # no 't'/'f'
+
+
+def test_json_schema_enum_and_integer():
+    g = compile_json_schema({"enum": [1, 23, 456]}, ALPHABET)
+    # greedy walk: "456" must be admitted char by char
+    s = 0
+    for ch in "456":
+        t = ALPHABET.index(ch)
+        assert g.allow[s, t]
+        s = g.step(s, t)
+    assert not g.live(s)  # complete
+
+
+def test_json_schema_unsupported_raises():
+    with pytest.raises(GrammarError):
+        compile_json_schema({"type": "number"}, ALPHABET)
+    with pytest.raises(GrammarError):
+        compile_json_schema({"type": "object"}, ALPHABET)  # no properties
+
+
+# -- exact allow-mask sampling (pure) --------------------------------------
+def test_sample_logits_allow_is_exact_and_identity_when_all_true():
+    rng = np.random.default_rng(0)
+    probs = rng.dirichlet(np.ones(V)).astype(np.float64)
+    allow = np.zeros(V, bool)
+    allow[[3, 7, 11]] = True
+    for seed in range(50):
+        r = np.random.default_rng(seed)
+        tok = sample_logits(probs, 2.0, None, r, None, allow=allow)
+        assert tok in (3, 7, 11)  # probability EXACTLY zero elsewhere
+    # all-True mask consumes the identical RNG draw as no mask
+    t1 = sample_logits(probs, 0.9, 5, np.random.default_rng(4), 0.9)
+    t2 = sample_logits(probs, 0.9, 5, np.random.default_rng(4), 0.9,
+                       allow=np.ones(V, bool))
+    assert t1 == t2
+
+
+# -- penalties (pure) -------------------------------------------------------
+def test_penalties_suppress_seen_tokens():
+    st = LogitState(V, repetition_penalty=2.0, frequency_penalty=0.5)
+    row = np.full(V, 1e-3)
+    row[4] = 0.9
+    assert int(st.adjust(row).argmax()) == 4  # nothing seen yet
+    for _ in range(6):
+        st.advance(4)
+    out = st.adjust(row)
+    assert out[4] < row[4]  # p^r * e^-(beta*count) pushed it down
+    assert out[5] == row[5]  # unseen tokens untouched
+
+
+def test_no_penalty_passthrough_is_same_object():
+    st = LogitState(V, stop=[[1, 2]])
+    row = np.full(V, 1.0 / V)
+    assert st.adjust(row) is row
+
+
+# -- accept_tokens x pipeline (pure) ---------------------------------------
+def _dist(winner):
+    row = np.full((V,), 1e-6)
+    row[winner] = 1.0
+    return row / row.sum()
+
+
+def test_accept_tokens_stops_at_grammar_exhaustion():
+    g = compile_trie([[4, 5]], V)
+    proc = LogitState(V, grammar=g)
+    rows = np.stack([_dist(t) for t in (4, 5, 6, 7)])
+    rng = np.random.default_rng(0)
+    emitted, matched = accept_tokens(rows, [4, 5, 6], 0.0, None, None,
+                                     rng, 99, None, proc=proc)
+    # after [4, 5] the grammar admits nothing: the chain stops there
+    # and the RNG is never consumed for the dead tail
+    assert emitted == [4, 5]
+    assert proc.exhausted()
+
+
+def test_accept_tokens_masks_each_position():
+    g = compile_trie([[9, 8]], V)
+    proc = LogitState(V, grammar=g)
+    # target would greedily pick 4 then 5 — the mask forces 9 then 8
+    rows = np.stack([_dist(t) for t in (4, 5, 6)])
+    emitted, _ = accept_tokens(rows, [9, 8], 0.0, None, None,
+                               np.random.default_rng(0), 99, None,
+                               proc=proc)
+    assert emitted == [9, 8]
+
+
+# -- mask pool (pure) -------------------------------------------------------
+def test_mask_pool_refcount_cache_and_eviction():
+    pool = MaskPool(32, [8, 16, 31])
+    g1, g2 = compile_trie([[1]], V), compile_trie([[2, 3]], V)
+    s1, up1 = pool.acquire(g1)
+    assert s1 == 1 and up1  # row 0 reserved
+    s1b, up1b = pool.acquire(g1)
+    assert s1b == s1 and not up1b  # cached, refcounted
+    s2, _ = pool.acquire(g2)
+    assert s2 == 9  # next bucket-aligned extent
+    pool.release(g1.key)
+    pool.release(g1.key)
+    pool.release(g2.key)
+    # a grammar too big for any bucket spills (host-only fallback)
+    from deeplearning4j_tpu.inference import CompiledGrammar
+    big = CompiledGrammar(V, np.ones((40, V), bool),
+                          np.zeros((40, V), np.int32),
+                          np.ones((40,), bool))
+    start, _ = pool.acquire(big)
+    assert start is None
+    # pressure evicts the zero-ref cached entries and reuses their rows
+    g3 = compile_trie([[4, 5, 6, 7, 8, 9, 10, 11, 12]], V)  # 10 states
+    s3, up3 = pool.acquire(g3)
+    assert s3 is not None and up3  # bucket 16 fit only via eviction
+    # a second 16-row grammar cannot fit while g3 is PINNED...
+    g4 = compile_trie([[10, 11, 12, 13, 14, 15, 16, 17, 18]], V)
+    s4, _ = pool.acquire(g4)
+    assert s4 is None  # refs > 0 entries are never evicted
+    # ...and fits the moment g3's pin drops
+    pool.release(g3.key)
+    s4, up4 = pool.acquire(g4)
+    assert s4 is not None and up4
+
+
+# -- token stream (pure) ----------------------------------------------------
+def test_token_stream_dedupes_reemission_by_index():
+    class H:
+        request_id = "r1"
+        tokens = [7, 8, 9]
+        finish_reason = "length"
+
+        def timings(self):
+            return {"total_ms": 1.0}
+
+    ts = TokenStream()
+    ts.push(0, 7)
+    ts.push(1, 8)
+    # crash-recovery re-decode re-emits from index 0 (token-identical)
+    ts.push(0, 7)
+    ts.push(1, 8)
+    ts.push(2, 9)
+    ts.close(H())
+    evts = list(ts.events())
+    toks = [e["token"] for e in evts if not e.get("done")]
+    assert toks == [7, 8, 9]  # each exactly once
+    assert evts[-1]["tokens"] == [7, 8, 9]
+    assert evts[-1]["finish_reason"] == "length"
+
+
+def test_token_stream_close_flushes_withheld_tokens():
+    class H:
+        request_id = "r2"
+        tokens = [1, 2, 3, 4]
+        finish_reason = None
+
+        def timings(self):
+            return {}
+
+    ts = TokenStream()
+    ts.push(0, 1)  # 2, 3, 4 withheld by a (hypothetical) stop hold-back
+    ts.close(H())
+    toks = [e["token"] for e in ts.events() if not e.get("done")]
+    assert toks == [1, 2, 3, 4]
+
+
+# -- engine: token identity -------------------------------------------------
+def test_admit_all_identical_greedy_and_sampled(net, prompt, base):
+    masked, m, _ = _run(net, prompt, gen_kw={"grammar": admit_all(V)})
+    assert masked.tokens == base
+    assert m.counter("constrained_requests_total").value == 1
+    s_base, _, _ = _run(net, prompt,
+                        gen_kw={"temperature": 0.9, "seed": 5, "top_k": 8})
+    s_mask, _, _ = _run(net, prompt,
+                        gen_kw={"temperature": 0.9, "seed": 5, "top_k": 8,
+                                "grammar": admit_all(V)})
+    assert s_mask.tokens == s_base.tokens
+
+
+def test_admit_all_identical_paged_within_budget(net, prompt, base):
+    paged, _, eng = _run(net, prompt, engine_kw={"kv_pool_mb": 0.5},
+                         gen_kw={"grammar": admit_all(V)})
+    assert paged.tokens == base
+    # the engine's own budget counter tracked every family from
+    # construction: the constrained run stayed inside <=1 masked
+    # program per table bucket (and everything else in budget)
+    eng._compile_counter.check()
+    counts = eng._compile_counter.counts()
+    assert 1 <= counts["masked_decode"] <= len(eng.table_buckets)
+    assert counts["mask_upload"] == 1  # one grammar, one upload bucket
+
+
+@pytest.mark.slow
+def test_admit_all_identical_tp2(net, prompt, base):
+    tp2, _, eng = _run(net, prompt,
+                       engine_kw={"kv_pool_mb": 0.5, "mesh": 2},
+                       gen_kw={"grammar": admit_all(V)})
+    assert eng.tp == 2  # sharding actually engaged
+    assert tp2.tokens == base
+
+
+def test_admit_all_identical_with_speculation_and_same_acceptance(
+        net, prompt, base):
+    plain, m1, _ = _run(net, prompt, engine_kw={"speculate": 2})
+    masked, m2, _ = _run(net, prompt, engine_kw={"speculate": 2},
+                         gen_kw={"grammar": admit_all(V)})
+    assert plain.tokens == masked.tokens == base
+    # acceptance-rate invariance under an admit-everything mask: the
+    # draft proposes and the verify scores bit-identical rows
+    assert (m1.counter("spec_tokens_accepted_total").value
+            == m2.counter("spec_tokens_accepted_total").value)
+    assert (m1.counter("spec_tokens_proposed_total").value
+            == m2.counter("spec_tokens_proposed_total").value)
+
+
+@pytest.mark.slow
+def test_host_only_mask_fallback_is_still_exact(net, prompt, base):
+    # mask_rows=0 disables the device table entirely: constrained
+    # decode must still be correct (and admit-all still identical)
+    masked, _, eng = _run(net, prompt, engine_kw={"mask_rows": 0},
+                          gen_kw={"grammar": admit_all(V)})
+    assert eng.maskpool is None
+    assert masked.tokens == base
+    forced, _, _ = _run(net, prompt, engine_kw={"mask_rows": 0},
+                        gen_kw={"grammar": compile_trie([[1, 2, 3]], V)})
+    assert forced.tokens == [1, 2, 3]
+
+
+# -- engine: constraint semantics ------------------------------------------
+def test_trie_grammar_forces_sequence_and_finishes(net, prompt):
+    h, _, _ = _run(net, prompt, gen_kw={"grammar":
+                                        compile_trie([[3, 1, 4]], V)})
+    assert h.tokens == [3, 1, 4]
+    assert h.finish_reason == "grammar"
+
+
+def test_stop_sequence_truncates_and_finishes(net, prompt, base):
+    stop = base[3:5]
+    first = next(i for i in range(len(base) - 1)
+                 if base[i:i + 2] == stop)
+    h, _, _ = _run(net, prompt, gen_kw={"stop": [stop]})
+    assert h.tokens == base[:first]
+    assert h.finish_reason == "stop"
+
+
+@pytest.mark.slow
+def test_stop_sequence_matches_across_speculative_burst(net, prompt):
+    b, _, _ = _run(net, prompt, engine_kw={"speculate": 3})
+    stop = b.tokens[3:5]
+    first = next(i for i in range(len(b.tokens) - 1)
+                 if b.tokens[i:i + 2] == stop)
+    h, _, _ = _run(net, prompt, engine_kw={"speculate": 3},
+                   gen_kw={"stop": [stop]})
+    assert h.tokens == b.tokens[:first]
+    assert h.finish_reason == "stop"
+
+
+def test_json_schema_completions_parse(net, prompt):
+    schema = {"type": "object", "properties": {
+        "a": {"type": "integer", "maxDigits": 2},
+        "b": {"type": "string", "maxLength": 3, "charset": "abc"}}}
+    g = compile_json_schema(schema, ALPHABET)
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          metrics=m, transfer_guard="disallow").start()
+    try:
+        for seed in range(3):
+            h = eng.generate_handle(prompt, 40, timeout=600, grammar=g,
+                                    temperature=1.0, seed=seed)
+            text = "".join(ALPHABET[t] for t in h.tokens)
+            obj = json.loads(text)  # must parse against the schema
+            assert isinstance(obj["a"], int)
+            assert set(obj["b"]) <= set("abc")
+            assert h.finish_reason == "grammar"
+    finally:
+        eng.stop()
+
+
+def test_streamed_equals_buffered(net, prompt, base):
+    ts = TokenStream()
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
+    try:
+        eng.submit(prompt, 12, stream=ts)
+        evts = list(ts.events(deadline=time.monotonic() + 600))
+    finally:
+        eng.stop()
+    toks = [e["token"] for e in evts if not e.get("done")]
+    done = evts[-1]
+    assert toks == done["tokens"] == base
+    assert done["finish_reason"] == "length"
+    assert done["timings"]["total_ms"] > 0
+
+
+def test_ttft_histogram_and_first_token_instant(net, prompt):
+    from deeplearning4j_tpu.inference.trace import FlightRecorder
+    tracer = FlightRecorder(4096)
+    m = MetricsRegistry()
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          metrics=m, tracer=tracer,
+                          transfer_guard="disallow").start()
+    try:
+        eng.generate(prompt, 4, timeout=600)
+    finally:
+        eng.stop()
+    hist = m.histogram("generate_first_token_seconds")
+    assert hist.count == 1
+    firsts = [e for e in tracer.events() if e["name"] == "first_token"]
+    assert len(firsts) == 1
+    assert firsts[0]["args"]["ttft_ms"] > 0
+
+
+# -- compile budgets --------------------------------------------------------
+@pytest.mark.slow
+def test_masked_families_within_budget_zero_per_request_recompiles(
+        net, prompt):
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_pool_mb=0.5, metrics=MetricsRegistry(),
+                          transfer_guard="disallow")
+    eng.warmup(masks=True)
+    counter = CompileCounter.for_scheduler(eng)
+    eng.start()
+    try:
+        g1 = admit_all(V)
+        g2 = compile_trie([[1, 2, 3, 4]], V)
+        outs = []
+        for g in (g1, g2, g1, None, g2):
+            outs.append(eng.generate(prompt, 6, timeout=600,
+                                     **({"grammar": g} if g else {})))
+        counts = counter.counts()
+        # warmed: the request mix compiled NOTHING — only the two
+        # grammars' mask uploads dispatched (already-compiled family)
+        assert all(n == 0 for n in counts.values()), counts
+    finally:
+        eng.stop()
+    counter.check()
+
+
+# -- HTTP: SSE streaming ----------------------------------------------------
+def _read_sse(resp):
+    buf, events = b"", []
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            line, buf = buf.split(b"\n\n", 1)
+            assert line.startswith(b"data: ")
+            events.append(json.loads(line[len(b"data: "):]))
+    return events
+
+
+@pytest.fixture(scope="module")
+def server(net):
+    # module-scoped (a supervised paged server costs ~10s to warm, and
+    # tier-1 is wall-clock-budgeted): tests assert counter DELTAS
+    srv = InferenceServer(net=net, decode_vocab=V, decode_slots=2,
+                          prefill_chunk=16, kv_pool_mb=0.5,
+                          hang_timeout_s=600).start()
+    yield srv
+    srv.stop()
+
+
+def _post_json(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def test_http_stream_token_identical_to_buffered(server, prompt):
+    import http.client
+    base = _post_json(server.port, "/generate",
+                      {"prompt": prompt, "max_new_tokens": 8})
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=300)
+    conn.request("POST", "/generate",
+                 json.dumps({"prompt": prompt, "max_new_tokens": 8,
+                             "stream": True}).encode(),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    rid = resp.getheader("X-Request-Id")
+    events = _read_sse(resp)
+    conn.close()
+    toks = [e["token"] for e in events if not e.get("done")]
+    done = events[-1]
+    assert toks == done["tokens"] == base["tokens"]
+    assert done["request_id"] == rid
+    assert done["finish_reason"] == "length"
+    assert set(done["timings"]) >= {"queue_ms", "prefill_ms",
+                                    "decode_ms", "total_ms"}
+    assert server.metrics.counter("stream_requests_total").value >= 1
+
+
+def test_http_stream_with_grammar_payload(server, prompt):
+    import http.client
+    base = _post_json(server.port, "/generate",
+                      {"prompt": prompt, "max_new_tokens": 8})
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=300)
+    conn.request("POST", "/generate",
+                 json.dumps({"prompt": prompt, "max_new_tokens": 8,
+                             "stream": True,
+                             "grammar": {"type": "admit_all"}}).encode(),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    events = _read_sse(resp)
+    conn.close()
+    assert events[-1]["tokens"] == base["tokens"]
+    # the compile cached: a second identical spec is a cache hit
+    before = server.metrics.counter("grammar_compiles_total").value
+    _post_json(server.port, "/generate",
+               {"prompt": prompt, "max_new_tokens": 4,
+                "grammar": {"type": "admit_all"}})
+    assert (server.metrics.counter("grammar_compiles_total").value
+            == before)
+
+
+def test_http_bad_grammar_is_400_not_500(server, prompt):
+    for spec in ({"type": "nope"},
+                 {"type": "json_schema", "schema": {"type": "boolean"},
+                  "alphabet": ALPHABET},  # uncoverable literal
+                 {"type": "json_schema", "schema": {}}):  # no alphabet
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(server.port, "/generate",
+                       {"prompt": prompt, "max_new_tokens": 4,
+                        "grammar": spec})
+        assert ei.value.code == 400
+        ei.value.read()
+
+
+def test_http_stream_rejects_best_of_n(server, prompt):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_json(server.port, "/generate",
+                   {"prompt": prompt, "max_new_tokens": 4,
+                    "stream": True, "n": 2})
+    assert ei.value.code == 400
+    ei.value.read()
+
+
+def test_http_stream_disconnect_reclaims_slot_and_pins(server, prompt):
+    """THE cancel-on-disconnect regression: a raw-socket client that
+    hangs up mid-decode must free the slot via DecodeHandle.cancel,
+    release every pool pin (a cancel publishes nothing: the pool's free
+    and reclaimable block counts return exactly to their pre-request
+    values — a leaked trie pin would depress reclaimable_blocks), and
+    count stream_disconnects_total exactly once."""
+    eng = server._decoder
+    d0 = server.metrics.counter("stream_disconnects_total").value
+    free0 = eng.pool.free_blocks
+    reclaim0 = eng.pool.reclaimable_blocks()
+    s = socket.create_connection(("127.0.0.1", server.port))
+    body = json.dumps({"prompt": prompt, "max_new_tokens": 100,
+                       "stream": True}).encode()
+    s.sendall(b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Type: application/json\r\n"
+              b"Content-Length: " + str(len(body)).encode()
+              + b"\r\n\r\n" + body)
+    head = s.recv(256)  # the stream started
+    assert b"200" in head
+    s.close()  # hang up mid-decode
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if (server.metrics.counter("stream_disconnects_total").value
+                > d0 and eng.inflight() == 0
+                and eng.pool.free_blocks == free0):
+            break
+        time.sleep(0.05)
+    assert (server.metrics.counter("stream_disconnects_total").value
+            == d0 + 1)
+    assert eng.inflight() == 0
+    assert server.metrics.counter("decode_cancelled_total").value >= 1
+    # nothing was published (cancel path) and nothing stays pinned:
+    # the request's blocks are all back on the free list and no trie
+    # node keeps a leaked reference
+    assert eng.pool.free_blocks == free0
+    assert eng.pool.reclaimable_blocks() == reclaim0
+
+
+# -- router: SSE pass-through ----------------------------------------------
+def test_router_pump_distinguishes_death_from_clean_eof(tmp_path):
+    """SSE bodies are close-delimited, so a SIGKILLed replica's FIN
+    reads exactly like a finished stream: the pump must journal finish
+    ONLY when the terminal done event arrived — a truncated stream is a
+    fail (replayable), and a zero-byte stream is a failover."""
+    import io
+    from email.message import Message
+    from deeplearning4j_tpu.serving.router import FleetRouter
+
+    class _Resp(io.BytesIO):
+        headers = Message()
+
+    class _Handler:
+        def __init__(self):
+            self.wfile = io.BytesIO()
+
+        def send_response(self, code):
+            pass
+
+        def send_header(self, *a):
+            pass
+
+        def end_headers(self):
+            pass
+
+    router = FleetRouter(replica_urls=["http://127.0.0.1:1"],
+                         journal_path=str(tmp_path / "j.log"),
+                         scrape_interval_s=3600)
+    try:
+        done = (b'data: {"token": 1, "index": 0}\n\n'
+                b'data: {"done": true, "tokens": [1]}\n\n')
+        router.journal.accept("r-ok", {})
+        assert router._pump_stream(_Handler(), "r-ok", "r0",
+                                   _Resp(done)) == "ok"
+        # bytes flowed but the stream died before its terminal event
+        router.journal.accept("r-cut", {})
+        assert router._pump_stream(
+            _Handler(), "r-cut", "r0",
+            _Resp(b'data: {"token": 1, "index": 0}\n\n')) == "truncated"
+        # nothing at all arrived: the caller may retry another replica
+        assert router._pump_stream(_Handler(), "r-zero", "r0",
+                                   _Resp(b"")) == "failover"
+        # a terminal event LARGER than the 64KB tail cap must still be
+        # recognized (the tail trims at event boundaries, never through
+        # the current event's `data: ` prefix)
+        big_tokens = list(range(20000))
+        big = (b'data: {"token": 1, "index": 0}\n\n' * 64
+               + b'data: ' + json.dumps(
+                   {"done": True, "tokens": big_tokens}).encode()
+               + b"\n\n")
+        assert len(big) > 65536
+        router.journal.accept("r-big", {})
+        assert router._pump_stream(_Handler(), "r-big", "r0",
+                                   _Resp(big)) == "ok"
+        st = router.journal.stats()
+        assert st["finished_total"] == 2  # r-ok + r-big
+        assert st["failed_total"] == 1    # r-cut (truncated = replayable
+        # terminal); failover journals nothing — the dispatch loop owns
+        # that request's outcome
+    finally:
+        router.journal.close()
+
+def test_router_stream_passthrough_and_journal(net, prompt, tmp_path):
+    import http.client
+    from deeplearning4j_tpu.serving.router import FleetRouter
+    srv = InferenceServer(net=net, decode_vocab=V, decode_slots=2,
+                          prefill_chunk=16, hang_timeout_s=600).start()
+    router = FleetRouter(replica_urls=[f"http://127.0.0.1:{srv.port}"],
+                         journal_path=str(tmp_path / "journal.log"),
+                         scrape_interval_s=0.2).start()
+    try:
+        base = _post_json(router.port, "/generate",
+                          {"prompt": prompt, "max_new_tokens": 8})
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=300)
+        conn.request("POST", "/generate",
+                     json.dumps({"prompt": prompt, "max_new_tokens": 8,
+                                 "stream": True}).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        events = _read_sse(resp)
+        conn.close()
+        toks = [e["token"] for e in events if not e.get("done")]
+        assert toks == events[-1]["tokens"] == base["tokens"]
+        # disconnect THROUGH the router: the replica's own cancel fires
+        s = socket.create_connection(("127.0.0.1", router.port))
+        body = json.dumps({"prompt": prompt, "max_new_tokens": 100,
+                           "stream": True}).encode()
+        s.sendall(b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: " + str(len(body)).encode()
+                  + b"\r\n\r\n" + body)
+        s.recv(256)
+        s.close()
+        eng = srv._decoder
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if (router.metrics.counter(
+                    "router_stream_disconnects_total").value >= 1
+                    and eng.inflight() == 0):
+                break
+            time.sleep(0.05)
+        assert router.metrics.counter(
+            "router_stream_disconnects_total").value == 1
+        assert srv.metrics.counter(
+            "stream_disconnects_total").value == 1  # cascaded cancel
+        assert eng.inflight() == 0
+        # a malformed STREAM prompt must 400 WITHOUT journaling an
+        # accept (an accepted-but-unterminable record would wedge the
+        # cursor and be falsely replayed after a restart)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(router.port, "/generate",
+                       {"prompt": "hello", "max_new_tokens": 4,
+                        "stream": True})
+        assert ei.value.code == 400
+        ei.value.read()
+        # journal: exactly one terminal per accept, no duplicates
+        router.journal.advance()
+        st = router.journal.stats()
+        assert st["accepted_total"] == 3
+        assert st["finished_total"] + st["failed_total"] == 3
+        assert st["duplicate_finishes_suppressed"] == 0
+    finally:
+        router.stop(stop_replicas=False)
+        srv.stop()
