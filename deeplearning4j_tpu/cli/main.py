@@ -16,8 +16,8 @@ Usage:
                    [--generate [--vocab-size V] [--decode-slots N]
                     [--prefill-chunk C] [--kv-pool-mb MB]
                     [--prefix-cache-mb MB] [--kv-block B]
-                    [--kv-dtype int8] [--mask-rows N]
-                    [--speculate GAMMA]
+                    [--kv-dtype int8] [--paged-kernel auto|on|off]
+                    [--mask-rows N] [--speculate GAMMA]
                     [--draft-blocks K] [--tp N]]
                    [--no-supervise] [--hang-timeout S] [--retry-budget N]
                    [--slo-p99-ms MS] [--no-profiler]
@@ -27,6 +27,7 @@ Usage:
                    [--ui URL]
   dl4j-tpu router  --spawn N --model model.zip [--journal journal.log]
                    [--port P] [--quorum Q] [--kv-block B]
+                   [--paged-kernel auto|on|off]
                    [--affinity-blocks K] [--replica-arg ARG ...]
                    | --replicas http://h:p,http://h:p (attach mode)
 """
@@ -125,6 +126,7 @@ def cmd_serve(args) -> int:
               kv_block=args.kv_block,
               kv_pool_mb=args.kv_pool_mb,
               kv_dtype=args.kv_dtype,
+              paged_kernel=args.paged_kernel,
               mask_rows=args.mask_rows,
               decode_tp=args.tp,
               speculate=args.speculate,
@@ -182,6 +184,15 @@ def cmd_serve(args) -> int:
             kw["decode_vocab"] = int(net.conf.vertices[out].layer.n_out)
         else:
             kw["decode_vocab"] = int(net.conf.layers[-1].n_out)
+    if args.generate and args.kv_pool_mb > 0 and args.paged_kernel != "off":
+        # arm ONLY the paged-decode seam BEFORE the engine builds, so
+        # the --paged-kernel knob has a kernel registered to dispatch
+        # (per-shape autotune keeps XLA wherever the kernel loses;
+        # "off" never needs the registration at all). Deliberately NOT
+        # the full enable(): that would also reroute /predict forwards
+        # and the GQA contraction through the attention helper.
+        from ..ops import pallas_kernels
+        pallas_kernels.enable_paged_decode()
     server = InferenceServer(net=net, **kw).start()
     batch_mode = ("lock-serialized" if args.no_batching else
                   f"micro-batched, window {args.batch_window_ms}ms, "
@@ -212,11 +223,16 @@ def cmd_serve(args) -> int:
     else:
         spec_mode = ""
     if paged_on:
+        # report the fused-kernel plane's ACTUAL engagement (the warmed
+        # engine's per-bucket verdicts), not just the flag
+        pk_st = decoder.paged_kernel_status()
+        kern = (f", decode kernel {pk_st['mode']}"
+                + ("/fused" if pk_st["engaged"] else "/xla"))
         kv_mode = (f", paged KV pool {args.kv_pool_mb}MB "
                    f"({decoder.pool.capacity_blocks} blocks of "
                    f"{args.kv_block}"
                    + (", int8 KV" if getattr(decoder, "kv_dtype", None)
-                      else "") + ")")
+                      else "") + ")" + kern)
     elif pool_on:
         kv_mode = (f", prefix cache {args.prefix_cache_mb}MB "
                    f"(block {args.kv_block})")
@@ -301,6 +317,8 @@ def cmd_router(args) -> int:
              "--kv-block", str(args.kv_block),
              "--affinity-blocks", str(args.affinity_blocks),
              "--quorum", str(args.quorum)]
+    if args.paged_kernel is not None:
+        argv += ["--paged-kernel", args.paged_kernel]
     if args.no_admission:
         argv += ["--no-admission"]
     return router.main(argv)
@@ -404,6 +422,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(per-row max-abs scales; less than half the "
                         "bytes per block, so the same --kv-pool-mb "
                         "holds 2x+ the blocks; paged mode only)")
+    s.add_argument("--paged-kernel", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="fused Pallas paged-decode kernel (paged mode "
+                        "only): 'auto' lets the per-shape autotune pick "
+                        "kernel vs XLA gather per decode bucket, 'on' "
+                        "forces the kernel, 'off' pins the XLA gather; "
+                        "outputs are token-identical either way "
+                        "(docs/serving.md 'Fused decode kernel')")
     s.add_argument("--mask-rows", type=int, default=64,
                    help="device rows of the grammar mask table backing "
                         "constrained decoding (/generate 'grammar': "
@@ -506,6 +532,10 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--kv-block", type=int, default=16,
                    help="the replicas' KV block size (the affinity "
                         "hash aligns to it)")
+    r.add_argument("--paged-kernel", choices=["auto", "on", "off"],
+                   default=None,
+                   help="fused-decode-kernel mode forwarded to every "
+                        "spawned replica (replicas default to 'auto')")
     r.add_argument("--affinity-blocks", type=int, default=1,
                    help="how many leading prompt blocks the affinity "
                         "hash covers")

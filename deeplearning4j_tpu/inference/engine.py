@@ -453,6 +453,19 @@ class DecodeScheduler:
     so a fixed ``kv_pool_mb`` holds 2x+ the blocks. Lossy: decode is
     plausible but not bit-identical to the f32 cache. Paged mode only.
 
+    ``paged_kernel``: fused Pallas decode-kernel mode (ISSUE 15),
+    paged layouts only. ``"auto"`` (default) lets the
+    ops/pallas_kernels per-shape autotune pick the FlashDecoding-style
+    page-walk kernel or the XLA gather per decode table bucket (silent
+    XLA fallback when no kernel is registered — `pallas_kernels.
+    enable()` arms it); ``"on"`` forces the kernel on every supported
+    T=1 decode shape; ``"off"`` pins the XLA gather path. Either way
+    prefill chunks, verify programs, and K/V writes stay in XLA, the
+    decision is trace-time (no extra programs — decode stays <= 1
+    program per table bucket), and outputs are token-identical by the
+    seam contract. `paged_kernel_engaged` gauge + the ``paged_kernel``
+    block of :meth:`debug_snapshot` report the per-bucket verdicts.
+
     ``transfer_guard``: device-residency audit mode. When set (e.g.
     "disallow"), every scheduler iteration runs under that thread-local
     ``jax.transfer_guard`` level: any *implicit* host<->device transfer in
@@ -466,6 +479,7 @@ class DecodeScheduler:
                  max_queue: int = 64, prefill_chunk: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
                  kv_pool_mb: float = 0.0, kv_dtype: Optional[str] = None,
+                 paged_kernel: str = "auto",
                  mask_rows: int = 64,
                  mesh=None, speculate: int = 0,
                  draft_blocks: Optional[int] = None, draft_net=None,
@@ -479,6 +493,10 @@ class DecodeScheduler:
         if kv_dtype not in (None, "int8"):
             raise ValueError(
                 f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        if paged_kernel not in ("auto", "on", "off"):
+            raise ValueError(
+                f"paged_kernel must be 'auto', 'on' or 'off', got "
+                f"{paged_kernel!r}")
         self.net = net
         self.vocab_size = int(vocab_size)
         self.n_slots = int(n_slots)
@@ -628,6 +646,11 @@ class DecodeScheduler:
         #     as the token-identity reference)
         self.kv_block = int(kv_block)
         self.kv_dtype: Optional[str] = None  # set when int8 KV engages
+        # fused Pallas decode-kernel mode (ISSUE 15): injected into the
+        # paged attention step as a trace-time constant next to the
+        # block table; "auto" defers to the ops/pallas_kernels per-shape
+        # autotune (silent XLA fallback when no kernel is registered)
+        self.paged_kernel = paged_kernel
         self.pool: Optional[KVPool] = None
         self.paged = False
         self.restore_buckets: List[int] = []
@@ -997,6 +1020,13 @@ class DecodeScheduler:
             "prefill_chunk_size", lo=1.0,
             hi=float(max(self.prefill_buckets or [1])) + 1, per_decade=12)
         if self.paged:
+            # fused-decode-kernel observability (ISSUE 15): 1 when any
+            # decode table bucket traced through the Pallas kernel
+            # (refreshed at warmup and on every /debug/engine read)
+            self._m_paged_kernel = m.gauge(
+                "paged_kernel_engaged",
+                help="fused Pallas paged-decode kernel engaged on at "
+                     "least one decode table bucket")
             self._m_preempted = m.counter("decode_preempted_total")
             # best-of-n COW forks: candidates that attached to a fork
             # group's published prompt blocks (zero-copy remaps)
@@ -1138,11 +1168,19 @@ class DecodeScheduler:
         scheduler mutates it between steps) and shipped per dispatch —
         never part of the carried device state — so allocation, restore
         remaps, COW swaps, and preemption are plain numpy writes with no
-        device program of their own."""
+        device program of their own.
+
+        ``paged_kernel``/``mesh`` ride along as TRACE-TIME constants
+        (this runs inside the jitted step body, so plain Python values
+        in the state dict are static — the layer reads them to pick the
+        fused decode kernel vs the XLA gather, ISSUE 15); like the
+        table, the layer never returns them."""
         out = {}
         for key, st in states.items():
             if isinstance(st, dict) and "k_pages" in st:
-                out[key] = {**st, "table": table, "wmask": wmask}
+                out[key] = {**st, "table": table, "wmask": wmask,
+                            "paged_kernel": self.paged_kernel,
+                            "mesh": self.mesh}
             else:
                 out[key] = st
         return out
@@ -3297,6 +3335,15 @@ class DecodeScheduler:
             nomask = self._dev_array(np.zeros((self.n_slots,), bool))
             self._jfixpos(self._states, posv, nomask)
             self._jdraft_fixpos(self._draft_states, posv, nomask)
+        if self.paged:
+            # the bucket loop above traced every decode program through
+            # the paged_decode_attention seam, so the kernel variant is
+            # compiled (and, in "auto", autotuned) INSIDE the same
+            # per-bucket program family — CompileCounter budgets are
+            # unchanged and a supervisor rebuild+warmup never pays a
+            # kernel compile under traffic. Refresh the engagement gauge
+            # now that every bucket has a verdict.
+            self.paged_kernel_status()
         if self.profiler.enabled and not self.profiler.costs:
             # a REBUILT engine (supervisor crash recovery / drain swap
             # over the same net) re-ingests the process-wide cached
@@ -3342,6 +3389,53 @@ class DecodeScheduler:
                         args={"error": type(e).__name__,
                               "detail": str(e)[:200]})
 
+    def paged_kernel_status(self) -> dict:
+        """Fused-decode-kernel engagement view (ISSUE 15): the mode
+        knob, whether ANY decode table bucket traced through the Pallas
+        kernel, and the per-bucket verdict — the kernel's grid variant
+        where it engaged, False where the trace fell back to XLA, None
+        for buckets not traced yet (warmup() traces every bucket, so a
+        warmed engine never shows None). Read-side only: consults the
+        ops/pallas_kernels trace-time engagement registry, never
+        triggers a compile or a probe."""
+        out = {"mode": self.paged_kernel, "engaged": False,
+               "buckets": {}}
+        if not self.paged:
+            return out
+        from ..ops import helpers as ophelpers
+        if (self.paged_kernel == "off"
+                or ophelpers.get_helper("paged_decode_attention") is None):
+            out["buckets"] = {nb: False for nb in self.table_buckets}
+            return out
+        from ..ops.pallas_kernels import paged_decode_decisions
+        dec = paged_decode_decisions()
+        # match THIS engine's traces exactly: batch/table/block dims,
+        # the per-shard head geometry of its own attention layers,
+        # compute dtype, int8-ness, AND its mode — the registry is
+        # process-global, and a co-resident engine over different
+        # shapes or another mode must not color these verdicts
+        dt = jnp.dtype(self._dtype).name
+        quant = self.kv_dtype == "int8"
+        heads = set()
+        for _, impl in self._impl_items():
+            if type(impl).__name__ == "SelfAttentionLayerImpl":
+                H = int(impl.conf.n_heads)
+                heads.add((impl._kv_heads() // self.tp, H // self.tp,
+                           int(impl.conf.n_out) // H))
+        for nb in self.table_buckets:
+            hits = [v for k, v in dec.items()
+                    if k[0] == self.n_slots and k[1] == nb
+                    and k[2] == self.kv_block and k[3:6] in heads
+                    and k[6] == dt and k[7] == quant
+                    and k[8] == self.paged_kernel]
+            engaged = [v for v in hits if v]
+            out["buckets"][nb] = (engaged[0] if engaged
+                                  else (False if hits else None))
+        out["engaged"] = any(bool(v) for v in out["buckets"].values())
+        if getattr(self, "_m_paged_kernel", None) is not None:
+            self._m_paged_kernel.set(1 if out["engaged"] else 0)
+        return out
+
     def debug_snapshot(self) -> dict:
         """`GET /debug/engine`: one JSON view of the engine's live
         anatomy — slot table, queue, block-pool occupancy + trie stats,
@@ -3383,6 +3477,19 @@ class DecodeScheduler:
         }
         if self.maskpool is not None:
             out["grammar_masks"] = self.maskpool.stats()
+        if self.paged:
+            # fused-kernel plane (ISSUE 15): mode, per-bucket fused-vs-
+            # XLA verdicts, and the paged family's autotune decisions
+            pk = self.paged_kernel_status()
+            try:
+                from ..ops.pallas_kernels import autotune_decisions
+                pk["autotune"] = {
+                    "/".join(map(str, k[1:])): v
+                    for k, v in autotune_decisions().items()
+                    if k[0] == "paged_decode"}
+            except Exception:
+                pk["autotune"] = {}
+            out["paged_kernel"] = pk
         if self.pool is not None:
             try:
                 out["pool"] = self.pool.stats()

@@ -98,9 +98,13 @@ _COST_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def _cost_shape_key(engine) -> tuple:
+    # paged_kernel is part of the key: the fused and XLA decode
+    # programs have different FLOPs/bytes tables, and two engines over
+    # one net may run different modes (the bench's A/B does)
     return (engine.tp, engine.paged, engine.speculate, engine.kv_dtype,
             engine.n_slots, tuple(engine.table_buckets),
-            tuple(engine.prefill_buckets))
+            tuple(engine.prefill_buckets),
+            getattr(engine, "paged_kernel", None))
 
 
 def cached_program_costs(engine):
@@ -211,6 +215,18 @@ def program_costs(engine) -> Dict[Tuple[str, int], Dict[str, float]]:
         for nb in engine.table_buckets:
             out[("decode", nb)] = _cost_of(engine._jstep.lower(
                 params, variables, ids, live, table(nb), engine._states))
+        # name which buckets run the fused Pallas kernel vs the XLA
+        # gather (ISSUE 15): the .lower() calls above traced every
+        # bucket through the paged_decode_attention seam, so the
+        # engagement registry has a verdict per bucket — /debug/engine's
+        # cost table carries it as a per-invocation "fused" flag
+        try:
+            fused = engine.paged_kernel_status()["buckets"]
+            for nb in engine.table_buckets:
+                out[("decode", nb)]["fused"] = (
+                    1.0 if fused.get(nb) else 0.0)
+        except Exception:
+            pass  # a stub engine without the status surface (tests)
         nb0 = engine.table_buckets[0]
         for b in engine.prefill_buckets:
             cids = engine._dev_array(np.zeros((b,), np.int32))

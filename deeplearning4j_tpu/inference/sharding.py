@@ -153,6 +153,31 @@ def storage_shardings(storage, mesh: Mesh, axis: str = TP_AXIS):
     return jax.tree_util.tree_map(lambda _: head, storage)
 
 
+def paged_kernel_shard_specs(axis: str = TP_AXIS) -> Dict[str, P]:
+    """PartitionSpecs for the fused paged-decode kernel's shard_map
+    (ops/pallas_kernels.py, ISSUE 15) — the SAME head-axis split the
+    engine already places its state with, so handing the kernel its
+    per-shard view costs zero resharding collectives:
+
+      - ``rows``: q [B, 1, H, Dh] / page arrays [pages, block, Hkv, Dh]
+        / the kernel output — head axis 2 over ``axis`` (matches
+        `state_shardings`' page placement and the column-parallel Wq's
+        propagated q split);
+      - ``scales``: int8 dequant scale pages [pages, block, Hkv] —
+        trailing head axis over ``axis``;
+      - ``host``: block tables and ``pos`` — replicated, like every
+        other host-authoritative input.
+
+    The kernel grids over the LOCAL Hkv shard inside the shard_map and
+    never communicates, so the per-token program keeps the Megatron
+    budget: exactly the two all-reduces per transformer block
+    (:func:`assert_hot_path_collectives` verifies this with the kernel
+    engaged, same audit as the XLA path)."""
+    return {"rows": P(None, None, axis, None),
+            "scales": P(None, None, axis),
+            "host": P()}
+
+
 def kv_heads_shardable(abstract_states, attn_keys, tp: int) -> bool:
     """True when every attention layer's Hkv head count divides by
     ``tp`` — the hard requirement for head-sharding the KV cache (param

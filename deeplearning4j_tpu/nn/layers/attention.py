@@ -37,6 +37,7 @@ from .base import LayerImpl, register_impl
 from .recurrent import BaseRecurrentImpl
 from .. import weights as winit
 from ...ops import helpers as ophelpers
+from ...ops.kvquant import dequantize_kv_rows, quantize_kv_rows
 
 Array = jax.Array
 
@@ -282,7 +283,21 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
 
         ``table``/``wmask`` are injected per call by the engine and not
         returned (the table is host-authoritative; device state carries
-        only pages + pos)."""
+        only pages + pos).
+
+        T=1 decode dispatches the attention READ through the
+        ``paged_decode_attention`` helper seam (ops/helpers.py): a
+        registered Pallas kernel (ops/pallas_kernels.py, ISSUE 15)
+        walks the block table page by page with an online softmax
+        instead of materializing the gathered cache, per-shape
+        autotuned with silent XLA fallback. The engine threads its
+        ``paged_kernel`` mode ("auto"/"on"/"off") and tp ``mesh`` in as
+        injected trace-time constants next to the table. The gather/
+        einsum body below STAYS the token-identity reference and the
+        fallback — prefill chunks (T > 1), unsupported shapes, and
+        autotune-picks-XLA all run it; K/V WRITES (wmask scratch
+        redirect, int8 quantize) always run here in XLA, the kernel
+        fuses only the read."""
         B, T, _ = x.shape
         pos = state0["pos"]          # [B] int32 (per-slot decode depths)
         table = state0["table"]      # [B, nb] int32, padded with page 0
@@ -316,33 +331,38 @@ class SelfAttentionLayerImpl(BaseRecurrentImpl):
             v_new = jnp.where(keep, v_new, 0)
         blk = jnp.where(p // Bk < nb, blk, 0)  # beyond-table -> scratch
         off = p % Bk
+        ks2 = vs2 = None
         if quantized:
-            dt = q.dtype
-
-            def quant(a):  # [B, T, Hkv, Dh] -> int8 rows + f32 scales
-                s = jnp.max(jnp.abs(a), axis=-1) / 127.0
-                s = jnp.maximum(s, jnp.asarray(1e-8, s.dtype))
-                rows = jnp.clip(jnp.round(a / s[..., None]), -127, 127)
-                return rows.astype(jnp.int8), s.astype(jnp.float32)
-
-            kq, ksc = quant(k_new)
-            vq, vsc = quant(v_new)
+            kq, ksc = quantize_kv_rows(k_new)   # ops/kvquant.py — the
+            vq, vsc = quantize_kv_rows(v_new)   # shared int8 contract
             kp2 = kp.at[blk, off].set(kq)
             vp2 = vp.at[blk, off].set(vq)
             ks2 = ks.at[blk, off].set(ksc)
             vs2 = vs.at[blk, off].set(vsc)
-            kc = (kp2[table].astype(dt)
-                  * ks2[table][..., None].astype(dt)).reshape(
-                B, L, kp.shape[2], kp.shape[3])
-            vc = (vp2[table].astype(dt)
-                  * vs2[table][..., None].astype(dt)).reshape(
-                B, L, vp.shape[2], vp.shape[3])
         else:
             kp2 = kp.at[blk, off].set(k_new)
             vp2 = vp.at[blk, off].set(v_new)
-            kc = kp2[table].reshape(B, L, kp.shape[2], kp.shape[3])
-            vc = vp2[table].reshape(B, L, vp.shape[2], vp.shape[3])
-        o = self._grouped_attention(q, kc, vc, causal=True, qpos0=pos)
+        o = None
+        if T == 1:
+            # fused page-walk decode kernel, or None = run the XLA
+            # reference below (trace-time decision — see class docstring)
+            o = ophelpers.paged_decode_attention(
+                q, kp2, vp2, table, pos, k_scales=ks2, v_scales=vs2,
+                mode=state0.get("paged_kernel", "auto"),
+                mesh=state0.get("mesh"))
+        if o is None:
+            dt = q.dtype
+            if quantized:
+                kc = dequantize_kv_rows(kp2[table], ks2[table],
+                                        dt).reshape(
+                    B, L, kp.shape[2], kp.shape[3])
+                vc = dequantize_kv_rows(vp2[table], vs2[table],
+                                        dt).reshape(
+                    B, L, vp.shape[2], vp.shape[3])
+            else:
+                kc = kp2[table].reshape(B, L, kp.shape[2], kp.shape[3])
+                vc = vp2[table].reshape(B, L, vp.shape[2], vp.shape[3])
+            o = self._grouped_attention(q, kc, vc, causal=True, qpos0=pos)
         if mask is not None:
             o = o * mask[:, :, None, None].astype(o.dtype)
         y = self._out(params, o, B, T)

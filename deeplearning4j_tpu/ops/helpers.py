@@ -258,3 +258,37 @@ def attention(q: Array, k: Array, v: Array, *, causal: bool = False,
     LSTM helpers."""
     impl = _HELPERS.get("attention", _attention_default)
     return impl(q, k, v, causal=causal, scale=scale)
+
+
+# -- fused paged-attention decode ----------------------------------------------
+
+def paged_decode_attention(q: Array, k_pages: Array, v_pages: Array,
+                           table: Array, pos: Array, *,
+                           k_scales=None, v_scales=None,
+                           mode: str = "auto", mesh=None):
+    """Fused paged-KV decode attention seam (ISSUE 15).
+
+    ``q``: [B, 1, H, Dh] single-token queries (RoPE already applied);
+    ``k_pages``/``v_pages``: [pages, block, Hkv, Dh] pool-wide page
+    arrays AFTER this step's write (page 0 = scratch); ``table``:
+    [B, nb] int32 block tables (scratch-padded); ``pos``: [B] int32
+    decode depths — row b attends causally over absolute positions
+    [0, pos[b]]. ``k_scales``/``v_scales``: [pages, block, Hkv] f32
+    dequant scales when the pages are int8 (ops/kvquant.py contract).
+    ``mode``: "auto" (per-shape autotune vs the XLA gather path) /
+    "on" (force the kernel) / "off". ``mesh``: the engine's tp mesh —
+    the registered kernel grids over the LOCAL Hkv shard via shard_map
+    so head-sharded serving never reshards (inference/sharding.py).
+
+    Returns [B, 1, H, Dh], or **None** — the contract's silent-fallback
+    arm: no kernel registered, mode "off", an unsupported shape, or a
+    per-shape autotune decision for XLA. The caller (the layer's
+    ``_paged_step``) then runs its own gather/einsum body, which stays
+    the token-identity reference. The decision is made at TRACE time
+    (shapes and mode are static), so a None costs nothing compiled.
+    """
+    impl = _HELPERS.get("paged_decode_attention")
+    if impl is None or mode == "off":
+        return None
+    return impl(q, k_pages, v_pages, table, pos, k_scales=k_scales,
+                v_scales=v_scales, mode=mode, mesh=mesh)

@@ -1,0 +1,60 @@
+"""Int8 KV-cache row quantization — THE shared definition.
+
+The paged decode step stores K/V rows as int8 against a per-(position,
+head) max-abs scale (engine ``kv_dtype="int8"``, ISSUE 10). The math
+lived as inline closures in ``SelfAttentionLayerImpl._paged_step``;
+ISSUE 15 factors it here so the three consumers can never drift:
+
+  - the XLA paged step (write-side quantize + gather-side dequantize,
+    nn/layers/attention.py),
+  - the fused Pallas decode kernel (per-row dequant INSIDE the page
+    loop, ops/pallas_kernels.py — jnp ops lower fine inside a kernel
+    body, so the kernel literally calls :func:`dequantize_kv_rows` on
+    its VMEM-resident page block),
+  - the KV-block transfer layer to come (ROADMAP item 3 ships int8
+    pages over the wire; its codec must round-trip through these exact
+    functions or adopted blocks would decode differently).
+
+Contract (pinned by tests/test_kvquant.py):
+
+  - scale is max-abs over the LAST axis (the head dim) divided by 127,
+    floored at ``SCALE_FLOOR`` = 1e-8 so an all-zero row (scratch-page
+    writes, padding lanes) quantizes to zeros instead of 0/0 NaNs;
+  - values round-to-nearest then clip to [-127, 127] (the int8 -128
+    code is never produced, keeping the codebook symmetric);
+  - dequantize multiplies in the CALLER's compute dtype — the paged
+    attention gather casts pages and scales to the query dtype before
+    the product, and the kernel must match that ordering for token
+    identity with the XLA path.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# all-zero rows (scratch page, masked lanes) would divide 0/0 without
+# this floor; any tiny positive value works — quantized zeros dequantize
+# to exact zeros regardless of the scale
+SCALE_FLOOR = 1e-8
+
+
+def quantize_kv_rows(a: Array) -> Tuple[Array, Array]:
+    """``[..., Dh]`` float rows -> (int8 rows ``[..., Dh]``, f32 scales
+    ``[...]``). Per-row symmetric max-abs quantization: one scale per
+    leading index (position, head), shared across the head dim."""
+    s = jnp.max(jnp.abs(a), axis=-1) / 127.0
+    s = jnp.maximum(s, jnp.asarray(SCALE_FLOOR, s.dtype))
+    rows = jnp.clip(jnp.round(a / s[..., None]), -127, 127)
+    return rows.astype(jnp.int8), s.astype(jnp.float32)
+
+
+def dequantize_kv_rows(rows: Array, scales: Array, dtype) -> Array:
+    """int8 rows ``[..., Dh]`` x f32 scales ``[...]`` -> float rows in
+    ``dtype``. Cast-then-multiply in the target dtype — the exact
+    ordering of the XLA gather path, which the Pallas kernel's in-loop
+    dequant must reproduce for bit-level agreement."""
+    return rows.astype(dtype) * scales[..., None].astype(dtype)

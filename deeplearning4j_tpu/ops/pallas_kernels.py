@@ -17,6 +17,14 @@ at ConvolutionLayer.java:64-70). Kernel families behind the seam:
     survivor past L~16k where dense cannot compile).
   - ``bn_act_pool``: composite BN+activation+2x2-maxpool with a fused
     2-pass Pallas BACKWARD in two layout-matched variants, autotuned.
+  - ``paged_decode_attention``: FlashDecoding-style fused paged-KV
+    decode (ISSUE 15) — one pass per (batch row, kv-head) walks the
+    slot's scalar-prefetched block table and runs QK^T + online softmax
+    + V accumulation page by page, int8 dequant fused in-loop; the
+    [B, nb*block, Hkv, Dh] gathered cache is never materialized. Per-
+    shape autotuned against the XLA gather path; under a tp mesh it
+    grids over the LOCAL Hkv shard (shard_map) so the serving
+    collective audit is unchanged.
   - ``lstm_sequence``: RETIRED round 4 (XLA's scan won every probed
     regime — see the tombstone note at the section below); the seam and
     the autotune machinery remain.
@@ -46,6 +54,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import activations
 from . import helpers
+from . import kvquant
 
 Array = jax.Array
 
@@ -414,17 +423,22 @@ _BNAP_AUTOTUNE_CACHE: Dict = {}
 
 def autotune_decisions() -> Dict:
     """Snapshot of ALL per-shape kernel-vs-XLA decisions made so far,
-    keyed ("attention", ...shape key...) / ("bn_act_pool", ...)."""
+    keyed ("attention", ...shape key...) / ("bn_act_pool", ...) /
+    ("paged_decode", ...)."""
     out = {("attention",) + k: v
            for k, v in _ATTN_AUTOTUNE_CACHE.items()}
     out.update({("bn_act_pool",) + k: v
                 for k, v in _BNAP_AUTOTUNE_CACHE.items()})
+    out.update({("paged_decode",) + k: v
+                for k, v in _PAGED_AUTOTUNE_CACHE.items()})
     return out
 
 
 def clear_autotune_cache() -> None:
     _ATTN_AUTOTUNE_CACHE.clear()
     _BNAP_AUTOTUNE_CACHE.clear()
+    _PAGED_AUTOTUNE_CACHE.clear()
+    _PAGED_ENGAGED.clear()
 
 
 def _eagerly(fn):
@@ -710,6 +724,368 @@ def attention_pallas(q, k, v, *, causal=False, scale=None):
 
 
 # =============================================================================
+# fused paged-attention decode (ISSUE 15 tentpole)
+# =============================================================================
+# The paged decode hot path gathered a slot's ENTIRE logical cache
+# [B, nb*block, Hkv, Dh] out of the page arrays every step (attention.py
+# `_paged_step`), so decode bandwidth scaled with pool capacity instead of
+# live tokens — and the int8 path additionally materialized a full
+# dequantized fp copy of that gather. This kernel is the FlashDecoding
+# treatment: one grid pass per (batch row, kv-head) walks the row's int32
+# block table (scalar-prefetched, so each page's HBM->VMEM stream is
+# issued straight off the table entry), computes QK^T + online softmax
+# (running max / sum-exp in VMEM scratch) + V accumulation page by page,
+# and dequantizes int8 rows in-loop via the shared ops/kvquant.py helpers.
+# The gathered cache never exists; HBM traffic is one pass over the rows
+# the table actually references.
+#
+# Seam contract (ops/helpers.py `paged_decode_attention`): the layer's
+# gather/einsum body STAYS as the token-identity reference and the
+# fallback — prefill chunks (T > 1), shapes the kernel does not support,
+# mode "off", and every shape where the per-shape autotune picks XLA all
+# return None here and run the reference. K/V WRITES (including the wmask
+# scratch-page redirect and int8 quantization) also stay in the XLA
+# prologue: the kernel fuses only the read side, so host-side table
+# surgery, COW, and masked-lane semantics are untouched.
+
+_PAGED_AUTOTUNE_CACHE: Dict = {}
+# every trace-time engagement decision (forced AND autotuned), keyed like
+# the autotune cache — the observability feed for the engine's
+# `paged_kernel_engaged` gauge and the /debug/engine cost table
+_PAGED_ENGAGED: Dict = {}
+_PAGED_DEFAULT_VARIANT = "bh"
+
+
+def paged_decode_decisions() -> Dict:
+    """Trace-time kernel-vs-XLA engagements for the paged-decode family
+    (includes forced ``mode="on"`` traces, unlike the autotune cache):
+    {(B, nb, block, Hkv, H, Dh, dtype, quantized, mode): variant | False}.
+    The MODE is part of the key — co-resident engines over the same
+    shapes but different ``paged_kernel`` modes (the bench's A/B
+    topology) must not overwrite each other's verdicts."""
+    return dict(_PAGED_ENGAGED)
+
+
+def enable_paged_decode(interpret=None) -> None:
+    """Register ONLY the paged-decode seam (the serve CLI's arming
+    path). Unlike :func:`enable`, this leaves every other helper —
+    attention, conv, bn_act_pool — at its XLA default: a serving
+    process that opted into ``--paged-kernel`` must not have its
+    /predict forwards or GQA contraction silently rerouted through the
+    rest of the plugin."""
+    global _INTERPRET
+    _INTERPRET = (jax.default_backend() != "tpu") if interpret is None \
+        else bool(interpret)
+    helpers.register_helper("paged_decode_attention",
+                            paged_decode_attention_pallas)
+
+
+def _paged_decode_body(table_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, o_ref, acc_ref, m_ref, l_ref, *, block,
+                       batch_major):
+    """One grid step = one page of one (batch row, kv-head) pair.
+
+    Grid (b, h, j) ("bh" variant; "hb" swaps the outer two), j the
+    LOGICAL block index — sequential on TPU, so the f32 VMEM scratch
+    (acc [G, Dh], running max m and sum-exp l) carries the online
+    softmax across the row's pages. The page itself arrives via the
+    BlockSpec index map reading the scalar-prefetched table
+    (``table_ref[b, j]``), i.e. the gather IS the block fetch. Blocks
+    past the row's decode depth are skipped whole; inside a live block,
+    positions beyond ``pos`` mask to -inf (same coverage as the
+    reference's ``arange(L) <= pos``). int8 pages dequantize per row
+    inside the loop (ops/kvquant.py — the exact cast-then-multiply the
+    XLA gather uses), so no fp copy of the table ever exists."""
+    del table_ref  # consumed by the index maps
+    b = pl.program_id(0 if batch_major else 1)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[b]
+
+    # skip pages wholly beyond this row's depth: the guard also keeps the
+    # running max finite (a processed block always has a valid position,
+    # since block j's first position j*block <= pos)
+    @pl.when(j * block <= pos)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)          # [G, Dh]
+        k = k_ref[0, :, 0]                           # [block, Dh]
+        v = v_ref[0, :, 0]
+        if ks_ref is not None:
+            k = kvquant.dequantize_kv_rows(k, ks_ref[0, :, 0], jnp.float32)
+            v = kvquant.dequantize_kv_rows(v, vs_ref[0, :, 0], jnp.float32)
+        else:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+        s = jax.lax.dot_general(                     # [G, block]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s = s / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+        offs = j * block + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block), 1)
+        s = jnp.where(offs <= pos, s, -jnp.inf)
+        m_prev = m_ref[:, 0:1]                       # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_ref[:, 0:1] * alpha \
+            + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nb - 1)
+    def _():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[:, 0:1]).astype(o_ref.dtype)
+
+
+def _paged_fp_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                     acc_ref, m_ref, l_ref, *, block, batch_major):
+    _paged_decode_body(table_ref, pos_ref, q_ref, k_ref, v_ref, None,
+                       None, o_ref, acc_ref, m_ref, l_ref, block=block,
+                       batch_major=batch_major)
+
+
+def _paged_int8_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, o_ref, acc_ref, m_ref, l_ref, *, block,
+                       batch_major):
+    _paged_decode_body(table_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, o_ref, acc_ref, m_ref, l_ref, block=block,
+                       batch_major=batch_major)
+
+
+def _paged_decode_call(q, k_pages, v_pages, table, pos, k_scales=None,
+                       v_scales=None, *, variant=_PAGED_DEFAULT_VARIANT):
+    """The pallas_call over LOCAL (per-shard) shapes. q: [B, 1, H, Dh];
+    k/v_pages: [pages, block, Hkv, Dh]; table: [B, nb] int32; pos: [B]
+    int32 -> [B, 1, H, Dh]. ``variant``: grid-major-order config probed
+    by the autotuner — "bh" walks all of a row's heads back-to-back
+    (q block reuse), "hb" streams one head's pages across the batch
+    (page-fetch pipeline depth B per head)."""
+    B, _, H, Dh = q.shape
+    block, Hkv = k_pages.shape[1], k_pages.shape[2]
+    G = H // Hkv
+    nb = table.shape[1]
+    qr = q.reshape(B, Hkv, G, Dh)  # head h*G+g, the _grouped_attention order
+    batch_major = variant != "hb"
+    if batch_major:
+        def bh(i0, i1):
+            return i0, i1
+        grid = (B, Hkv, nb)
+    else:
+        def bh(i0, i1):
+            return i1, i0
+        grid = (Hkv, B, nb)
+
+    def qmap(i0, i1, j, tref, pref):
+        b, h = bh(i0, i1)
+        return (b, h, 0, 0)
+
+    def kmap(i0, i1, j, tref, pref):
+        b, h = bh(i0, i1)
+        return (tref[b, j], 0, h, 0)
+
+    def smap(i0, i1, j, tref, pref):
+        b, h = bh(i0, i1)
+        return (tref[b, j], 0, h)
+
+    in_specs = [pl.BlockSpec((1, 1, G, Dh), qmap),
+                pl.BlockSpec((1, block, 1, Dh), kmap),
+                pl.BlockSpec((1, block, 1, Dh), kmap)]
+    args = [qr, k_pages, v_pages]
+    kern = _paged_fp_kernel
+    if k_scales is not None:
+        in_specs += [pl.BlockSpec((1, block, 1), smap),
+                     pl.BlockSpec((1, block, 1), smap)]
+        args += [k_scales, v_scales]
+        kern = _paged_int8_kernel
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, G, Dh), qmap),
+        scratch_shapes=[pltpu.VMEM((G, Dh), jnp.float32),
+                        pltpu.VMEM((G, 128), jnp.float32),
+                        pltpu.VMEM((G, 128), jnp.float32)])
+    out = pl.pallas_call(
+        partial(kern, block=block, batch_major=batch_major),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, Dh), q.dtype),
+        grid_spec=grid_spec,
+        interpret=_INTERPRET,
+    )(table.astype(jnp.int32), pos.astype(jnp.int32), *args)
+    return out.reshape(B, 1, H, Dh)
+
+
+def _xla_paged_reference(q, k_pages, v_pages, table, pos, k_scales=None,
+                         v_scales=None):
+    """The current XLA gather path as a standalone function — the
+    autotune probe's baseline and the tests' bit-level oracle. Mirrors
+    attention.py `_paged_step`'s read side exactly: gather the whole
+    logical cache through the table (dequantizing the int8 pool to the
+    query dtype first), then the grouped contraction + f32 softmax of
+    `_grouped_attention` with per-row causal depths."""
+    B, T, H, Dh = q.shape
+    block, Hkv = k_pages.shape[1], k_pages.shape[2]
+    L = table.shape[1] * block
+    dt = q.dtype
+    if k_scales is not None:
+        kc = kvquant.dequantize_kv_rows(
+            k_pages[table], k_scales[table], dt).reshape(B, L, Hkv, Dh)
+        vc = kvquant.dequantize_kv_rows(
+            v_pages[table], v_scales[table], dt).reshape(B, L, Hkv, Dh)
+    else:
+        kc = k_pages[table].reshape(B, L, Hkv, Dh)
+        vc = v_pages[table].reshape(B, L, Hkv, Dh)
+    qg = q.reshape(B, T, Hkv, H // Hkv, Dh)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kc) / jnp.sqrt(
+        jnp.asarray(Dh, dt))
+    valid = (jnp.arange(L)[None, None, :]
+             <= pos[:, None, None] + jnp.arange(T)[None, :, None])
+    s = jnp.where(valid[:, None, None], s.astype(jnp.float32),
+                  jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dt)
+    return jnp.einsum("bhgqk,bkhd->bqhgd", p, vc).reshape(B, T, H, Dh)
+
+
+@_eagerly
+def _autotune_paged_decode(B, nb, block, Hkv, H, Dh, dtype, quantized):
+    """Probe the fused decode kernel's grid configs against the XLA
+    gather path on this exact LOCAL shape (one decode step, carry-
+    chained through _measure_scan). Returns the winning variant string
+    or False for XLA. Rows are probed at FULL table depth — the
+    regime the bucket was compiled for; shallower rows only shrink the
+    kernel's walk. Selection needs a >= 5% win (find-algorithm margin
+    over probe noise); a reference that cannot even run while the
+    kernel measured healthy is a walkover, like the attention seam."""
+    if _INTERPRET:
+        # interpreter probes measure the interpreter, not the op: the
+        # seam silently keeps XLA (tests force the kernel with "on")
+        return False
+    import numpy as np
+    rng = np.random.default_rng(0)
+    pages = B * nb + 1
+    kp = jnp.asarray(rng.normal(size=(pages, block, Hkv, Dh)), dtype)
+    vp = jnp.asarray(rng.normal(size=(pages, block, Hkv, Dh)), dtype)
+    ks = vs = None
+    if quantized:
+        kp, ks = kvquant.quantize_kv_rows(kp)
+        vp, vs = kvquant.quantize_kv_rows(vp)
+    table = jnp.asarray(
+        1 + np.arange(B * nb, dtype=np.int32).reshape(B, nb))
+    pos = jnp.full((B,), nb * block - 1, jnp.int32)
+    q0 = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), dtype)
+
+    def step(fn):
+        def s(qc):
+            out = fn(qc, kp, vp, table, pos, ks, vs)
+            return qc + jnp.asarray(1e-6, qc.dtype) * out.astype(qc.dtype)
+        return s
+
+    K = 16 if nb * block <= 4096 else 8
+    best = None  # (time, variant)
+    for variant in ("bh", "hb"):
+        def fn(qc, kp, vp, tb, ps, ks, vs, variant=variant):
+            return _paged_decode_call(qc, kp, vp, tb, ps, ks, vs,
+                                      variant=variant)
+        try:
+            t = _measure_scan(step(fn), q0, K=K, repeats=2)
+        except Exception:
+            continue
+        if best is None or t < best[0]:
+            best = (t, variant)
+    if best is None:
+        return False
+    try:
+        t_r = _measure_scan(step(_xla_paged_reference), q0, K=K, repeats=2)
+    except Exception:
+        # walkover: the gather path blew up (at large pools its
+        # materialized [B, nb*block, Hkv, Dh] cache can exceed HBM)
+        # while the kernel just measured healthy on this shape
+        return best[1]
+    return best[1] if best[0] * 1.05 < t_r else False
+
+
+def paged_decode_attention_pallas(q, k_pages, v_pages, table, pos, *,
+                                  k_scales=None, v_scales=None,
+                                  mode="auto", mesh=None):
+    """Seam override for `ops.helpers.paged_decode_attention`: per-shape
+    autotuned choice between the fused page-walk kernel and the XLA
+    gather path (returns None = caller runs its reference body — the
+    silent-fallback contract). Under a tp mesh the kernel runs inside
+    shard_map over the LOCAL Hkv shard (q/pages head-split, table/pos
+    replicated — the layout the engine already carries), so the
+    compiled program keeps the Megatron all-reduce-only collective
+    budget: the kernel itself never communicates."""
+    B, T, H, Dh = q.shape
+    block, Hkv = k_pages.shape[1], k_pages.shape[2]
+    # f32 only: the kernel accumulates QK^T/softmax/PV in f32, which
+    # matches the XLA reference's arithmetic for f32 engines but NOT a
+    # bf16 engine's (the reference contracts in the model dtype) — a
+    # sub-f32 compute dtype falls back so the token-identity contract
+    # holds; a dtype-disciplined bf16 variant is future headroom
+    if T != 1 or H % Hkv or mode == "off" or q.dtype != jnp.float32:
+        return None
+    quantized = k_scales is not None
+    tp = 1
+    axis = "tp"
+    if mesh is not None:
+        try:
+            from ..inference.sharding import TP_AXIS as axis
+        except Exception:
+            pass
+        tp = int(dict(mesh.shape).get(axis, 1))
+        if tp > 1 and (Hkv % tp or H % tp):
+            return None
+    key = (B, int(table.shape[1]), block, Hkv // tp, H // tp, Dh,
+           jnp.dtype(q.dtype).name, quantized)
+    if mode == "on":
+        variant = _PAGED_DEFAULT_VARIANT
+    else:
+        if key not in _PAGED_AUTOTUNE_CACHE:
+            _PAGED_AUTOTUNE_CACHE[key] = _autotune_paged_decode(
+                *key[:6], q.dtype, quantized)
+        variant = _PAGED_AUTOTUNE_CACHE[key]
+    _PAGED_ENGAGED[key + (mode,)] = variant
+    if not variant:
+        return None
+    if tp > 1:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding
+        from ..inference.sharding import paged_kernel_shard_specs
+        sp = paged_kernel_shard_specs(axis)
+        hs4, hs3, rep = sp["rows"], sp["scales"], sp["host"]
+        # anchor q's propagated placement to the head split the
+        # column-parallel Wq already implies — a no-op when GSPMD
+        # agrees, and it keeps the audit at zero resharding when it
+        # would otherwise hedge
+        q = jax.lax.with_sharding_constraint(
+            q, NamedSharding(mesh, hs4))
+        if quantized:
+            fn = shard_map(
+                partial(_paged_decode_call, variant=variant),
+                mesh=mesh,
+                in_specs=(hs4, hs4, hs4, rep, rep, hs3, hs3),
+                out_specs=hs4, check_rep=False)
+            return fn(q, k_pages, v_pages, table, pos, k_scales,
+                      v_scales)
+        fn = shard_map(
+            partial(_paged_decode_call, variant=variant),
+            mesh=mesh, in_specs=(hs4, hs4, hs4, rep, rep),
+            out_specs=hs4, check_rep=False)
+        return fn(q, k_pages, v_pages, table, pos)
+    return _paged_decode_call(q, k_pages, v_pages, table, pos, k_scales,
+                              v_scales, variant=variant)
+
+
+# =============================================================================
 # registration
 # =============================================================================
 
@@ -750,6 +1126,12 @@ def enable(interpret=None, use_conv=None, use_bn_act_pool=None) -> None:
     if use_conv:
         helpers.register_helper("conv2d_bias_act", conv2d_bias_act_pallas)
     helpers.register_helper("attention", attention_pallas)
+    # paged-decode is registered unconditionally like attention: its own
+    # per-shape autotune (and the engine's paged_kernel mode knob) keeps
+    # XLA wherever the kernel does not win, and in interpreter runs the
+    # "auto" decision is always XLA — tests force engagement with "on"
+    helpers.register_helper("paged_decode_attention",
+                            paged_decode_attention_pallas)
     if use_bn_act_pool:
         helpers.register_helper("bn_act_pool", bn_act_pool_pallas)
 
@@ -758,4 +1140,5 @@ def disable() -> None:
     """Restore the XLA default implementations (silent-fallback seam)."""
     helpers.register_helper("conv2d_bias_act", None)
     helpers.register_helper("attention", None)
+    helpers.register_helper("paged_decode_attention", None)
     helpers.register_helper("bn_act_pool", None)

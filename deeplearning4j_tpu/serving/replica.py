@@ -523,6 +523,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--kv-pool-mb", type=float, default=0.0)
     ap.add_argument("--prefix-cache-mb", type=float, default=0.0)
     ap.add_argument("--kv-dtype", default=None)
+    ap.add_argument("--paged-kernel", choices=["auto", "on", "off"],
+                    default="auto")
     ap.add_argument("--tp", type=int, default=0)
     ap.add_argument("--slo-p99-ms", type=float, default=None)
     ap.add_argument("--hang-timeout", type=float, default=5.0)
@@ -535,6 +537,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .server import InferenceServer
 
     armed = failpoints.arm_from_env()  # fleet chaos arms seams HERE
+    if args.kv_pool_mb > 0 and args.paged_kernel != "off":
+        # same contract as `dl4j-tpu serve`: arm ONLY the paged-decode
+        # seam before the engine builds so --paged-kernel has a kernel
+        # to dispatch (autotune keeps XLA wherever it loses; the rest
+        # of the plugin — attention/conv/bn — stays at XLA defaults)
+        from ..ops import pallas_kernels
+        pallas_kernels.enable_paged_decode()
     net = _build_net(args)
     if hasattr(net.conf, "vertices"):
         out = net.conf.network_outputs[0]
@@ -546,6 +555,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         decode_slots=args.slots, prefill_chunk=args.prefill_chunk,
         kv_block=args.kv_block, kv_pool_mb=args.kv_pool_mb,
         prefix_cache_mb=args.prefix_cache_mb, kv_dtype=args.kv_dtype,
+        paged_kernel=args.paged_kernel,
         decode_tp=args.tp, slo_p99_ms=args.slo_p99_ms,
         hang_timeout_s=args.hang_timeout, retry_budget=args.retry_budget,
         trace_buffer=args.trace_buffer,

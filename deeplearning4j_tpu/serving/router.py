@@ -1491,6 +1491,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="JSON file to write {port, pid} into once "
                          "serving")
     ap.add_argument("--kv-block", type=int, default=16)
+    ap.add_argument("--paged-kernel", choices=["auto", "on", "off"],
+                    default=None,
+                    help="forward a fused-decode-kernel mode to every "
+                         "SPAWNED replica (ISSUE 15; replicas default "
+                         "to 'auto' — per-shape autotune vs XLA)")
     ap.add_argument("--affinity-blocks", type=int, default=1)
     ap.add_argument("--quorum", type=int, default=1)
     ap.add_argument("--scrape-interval", type=float, default=0.5)
@@ -1504,8 +1509,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     armed = failpoints.arm_from_env()  # router seams arm from the env
     if args.spawn:
+        replica_argv = list(args.replica_arg)
+        if args.paged_kernel is not None:
+            replica_argv += ["--paged-kernel", args.paged_kernel]
         sup = ReplicaSupervisor(
-            [ReplicaProcess(list(args.replica_arg), name=f"r{i}")
+            [ReplicaProcess(replica_argv, name=f"r{i}")
              for i in range(args.spawn)])
     else:
         sup = ReplicaSupervisor(

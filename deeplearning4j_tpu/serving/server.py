@@ -43,6 +43,11 @@ hidden dims split across the ``tp`` axis, the KV pool shards by head
 per-device HBM), and the mesh topology + per-device pool bytes surface
 as ``decode_mesh_devices`` / ``kv_pool_device_bytes`` gauges in
 `GET /metrics`, `GET /info`, and the UI `/serving` page.
+``paged_kernel`` (`--paged-kernel auto|on|off`, ISSUE 15) picks the
+fused Pallas paged-decode kernel vs the XLA gather per decode bucket
+("auto" = per-shape autotune, docs/serving.md "Fused decode kernel");
+the `paged_kernel_engaged` gauge and the ``paged_kernel`` block of
+`GET /debug/engine` report the live verdicts.
 
 Observability (`inference/trace.py`): the server owns a span flight
 recorder written from the HTTP layer, batcher, decode scheduler, and KV
@@ -212,6 +217,7 @@ class InferenceServer:
                  prefill_chunk: int = 64, decode_queue: int = 64,
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
                  kv_pool_mb: float = 0.0, kv_dtype: Optional[str] = None,
+                 paged_kernel: str = "auto",
                  mask_rows: int = 64,
                  decode_tp: int = 0, speculate: int = 0,
                  draft_blocks: int = 0, draft_net=None,
@@ -246,6 +252,12 @@ class InferenceServer:
         self.kv_block = int(kv_block)
         self.kv_pool_mb = float(kv_pool_mb)
         self.kv_dtype = kv_dtype
+        # fused Pallas decode kernel (ISSUE 15): the factory passes the
+        # mode through on every (re)build, so crash recovery and
+        # draining restarts come back with the same kernel decision —
+        # warmup inside the supervisor's recovery window covers the
+        # kernel variant, keeping CompileCounter budgets across swaps
+        self.paged_kernel = paged_kernel
         # grammar-constrained decoding (ISSUE 14): device mask-table
         # rows; grammar specs in /generate payloads compile ONCE (cache
         # below, keyed by spec bytes) ahead of admission
@@ -346,6 +358,7 @@ class InferenceServer:
             kv_block=self.kv_block,
             kv_pool_mb=self.kv_pool_mb,
             kv_dtype=self.kv_dtype,
+            paged_kernel=self.paged_kernel,
             mask_rows=self.mask_rows,
             mesh=self.decode_tp if self.decode_tp > 1 else None,
             speculate=self.speculate,

@@ -220,3 +220,37 @@ def test_constrained_stream_healthy_row_passes():
                                       "outputs_identical": 1,
                                       "outputs_valid": 1}}
     assert bench.check_floors(healthy) == []
+
+
+def test_paged_decode_kernel_regressions_are_caught():
+    """ISSUE 15 acceptance floors: the fused decode kernel must stay
+    token-identical to the XLA gather path at every probed page count
+    (identity floor = 1 everywhere), and wherever the AUTOTUNER engages
+    the kernel its step-time speedup must hold >= 0.9 — a kernel that
+    autotune selects but that then decodes slower than the gather it
+    replaced (a probe/serving regime mismatch) must trip the gate, as
+    must either field going missing."""
+    divergent = {"paged_decode_kernel": {"outputs_identical": 0,
+                                         "engaged_ratio": 1.0}}
+    regs = bench.check_floors(divergent)
+    assert any("outputs_identical" in r for r in regs), regs
+
+    slow = {"paged_decode_kernel": {"outputs_identical": 1,
+                                    "engaged_ratio": 0.5}}
+    regs = bench.check_floors(slow)
+    assert any("engaged_ratio=0.5 < floor" in r for r in regs), regs
+
+    renamed = {"paged_decode_kernel": {"outputs_identical": 1}}
+    regs = bench.check_floors(renamed)
+    assert any("engaged_ratio missing" in r for r in regs), regs
+
+
+def test_paged_decode_kernel_healthy_rows_pass():
+    # CPU row: autotune keeps XLA everywhere -> neutral ratio 1.0
+    cpu = {"paged_decode_kernel": {"outputs_identical": 1,
+                                   "engaged_ratio": 1.0}}
+    assert bench.check_floors(cpu) == []
+    # TPU row: kernel engaged and faster where it engaged
+    tpu = {"paged_decode_kernel": {"outputs_identical": 1,
+                                   "engaged_ratio": 1.42}}
+    assert bench.check_floors(tpu) == []

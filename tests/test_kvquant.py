@@ -1,0 +1,89 @@
+"""ops/kvquant.py — the shared int8 KV row-quantization contract.
+
+ISSUE 15 satellite: the quant/dequant math moved out of
+`SelfAttentionLayerImpl._paged_step`'s inline closures into ops/kvquant.py
+so the XLA paged step and the fused Pallas decode kernel consume ONE
+definition. These tests pin the contract both depend on: per-row max-abs
+scales with the 1e-8 floor, symmetric [-127, 127] codes, the round-trip
+error bound, and the dequant dtype/ordering the kernel must reproduce for
+token identity.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.kvquant import (SCALE_FLOOR,
+                                            dequantize_kv_rows,
+                                            quantize_kv_rows)
+
+
+def test_roundtrip_error_bounded_by_half_scale():
+    """Symmetric round-to-nearest: |x - deq(q(x))| <= scale/2 per row
+    (the classic uniform-quantizer bound; no clipping occurs because the
+    scale is max-abs/127)."""
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.normal(size=(5, 3, 4, 16)) * 3.0, jnp.float32)
+    rows, scales = quantize_kv_rows(a)
+    assert rows.dtype == jnp.int8 and scales.dtype == jnp.float32
+    assert rows.shape == a.shape and scales.shape == a.shape[:-1]
+    deq = dequantize_kv_rows(rows, scales, jnp.float32)
+    err = np.asarray(jnp.abs(deq - a))
+    bound = np.asarray(scales)[..., None] * 0.5 + 1e-7
+    assert (err <= bound).all(), float(err.max())
+
+
+def test_codes_symmetric_never_minus_128():
+    """The int8 -128 code is never produced (clip to [-127, 127]), so
+    the codebook stays symmetric and dequant needs no special case."""
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.normal(size=(64, 8)) * 100.0, jnp.float32)
+    rows, _ = quantize_kv_rows(a)
+    r = np.asarray(rows)
+    assert r.min() >= -127 and r.max() <= 127
+    # max-abs element lands on +/-127 exactly
+    assert (np.abs(r).max(axis=-1) == 127).all()
+
+
+def test_zero_rows_hit_scale_floor_and_roundtrip_to_zero():
+    """All-zero rows (scratch-page writes, masked lanes) must quantize
+    through the 1e-8 scale floor — no 0/0 NaNs — and dequantize to
+    exact zeros."""
+    a = jnp.zeros((4, 2, 8), jnp.float32)
+    rows, scales = quantize_kv_rows(a)
+    assert np.asarray(scales == SCALE_FLOOR).all()
+    assert not np.isnan(np.asarray(rows)).any()
+    deq = dequantize_kv_rows(rows, scales, jnp.float32)
+    assert np.asarray(deq == 0.0).all()
+
+
+def test_tiny_rows_below_floor_quantize_to_zero_not_garbage():
+    """Rows whose max-abs sits below 127 * floor would divide by the
+    floor, not their own scale: values quantize toward zero instead of
+    amplifying numeric noise into full-scale codes."""
+    a = jnp.full((2, 8), 1e-10, jnp.float32)
+    rows, scales = quantize_kv_rows(a)
+    assert np.asarray(scales == SCALE_FLOOR).all()
+    # 1e-10 / 1e-8 = 0.01 -> rounds to code 0
+    assert np.asarray(rows == 0).all()
+
+
+def test_dequant_multiplies_in_target_dtype():
+    """Dequant casts rows AND scales to the target dtype before the
+    product — the exact ordering of the XLA gather path; the Pallas
+    kernel's in-loop dequant calls this same function, which is what
+    makes the int8 paths bit-agreeable."""
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.normal(size=(3, 8)), jnp.float32)
+    rows, scales = quantize_kv_rows(a)
+    out = dequantize_kv_rows(rows, scales, jnp.bfloat16)
+    assert out.dtype == jnp.bfloat16
+    ref = rows.astype(jnp.bfloat16) * scales[..., None].astype(jnp.bfloat16)
+    assert np.asarray(out == ref).all()
+
+
+def test_paged_step_consumes_shared_helpers():
+    """The attention layer must not regrow private quant closures: its
+    module imports resolve to ops/kvquant.py's definitions."""
+    from deeplearning4j_tpu.nn.layers import attention as att
+    from deeplearning4j_tpu.ops import kvquant
+    assert att.quantize_kv_rows is kvquant.quantize_kv_rows
+    assert att.dequantize_kv_rows is kvquant.dequantize_kv_rows
