@@ -1457,6 +1457,123 @@ def bench_race_audit(prompt_len=64, new_tokens=24, chunk=32, vocab=64,
     }
 
 
+def bench_ledger_overhead(prompt_len=64, new_tokens=24, chunk=32, vocab=64,
+                          n_reqs=6, rounds=8, d_model=128) -> dict:
+    """Resource-ledger seam cost A/B (ISSUE 18 acceptance: even the
+    ARMED graftleak ledger must cost <= 2% on the decode hot loop — and
+    the production-resident DISARMED seams, a strict subset of the
+    armed work, less still). ONE paged decode scheduler — the seams are
+    module-global, so there is no per-engine arming — alternates
+    disarmed and armed rounds over the same prompts; the armed phase
+    runs inside a `resource_ledger` window, so every trie-pin /
+    pool-block / slot note really fans into a live ledger. The floor
+    metric is the disarmed/armed mean step time pooled over the timed
+    iterations of each phase (same step-histogram protocol as
+    race_audit). Also measures the raw per-note seam cost both ways.
+    Standalone-runnable:
+        python -c "import bench, json; print(json.dumps(bench.bench_ledger_overhead()))"
+    """
+    from deeplearning4j_tpu.analysis.runtime import (ledger_note,
+                                                     resource_ledger)
+    from deeplearning4j_tpu.inference import DecodeScheduler, MetricsRegistry
+    from deeplearning4j_tpu.models.zoo import transformer_lm
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    # d128 like race_audit: the per-seam overhead is FIXED (a dict
+    # emptiness test disarmed, a lock + dict update armed), so the <=2%
+    # budget must be judged against a realistic step, not a toy's
+    conf = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = prompt_len + new_tokens
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(6)
+    prompts = [list(rng.integers(0, vocab, prompt_len))
+               for _ in range(n_reqs)]
+    # paged mode so the dense seam sites (alloc/free per block, pin per
+    # prefix hit, slot per admit) actually run; pool sized ~1.5x the
+    # concurrent working set so rounds recycle blocks without thrash
+    blocks_each = -(-(prompt_len + new_tokens) // 8)
+    pool_blocks = int(n_reqs * blocks_each * 1.5)
+    # bytes/block: 2 (k+v) * n_blocks layers * d_model f32 per position
+    pool_mb = (pool_blocks + 1) * 8 * (2 * 2 * d_model * 4) / float(1 << 20)
+    eng = DecodeScheduler(net, vocab, n_slots=4, prefill_chunk=chunk,
+                          kv_pool_mb=pool_mb, kv_block=8,
+                          metrics=MetricsRegistry()).start()
+
+    def run_once():
+        t0 = time.perf_counter()
+        for h in [eng.submit(p, new_tokens) for p in prompts]:
+            h.result(600)
+        return n_reqs * new_tokens / (time.perf_counter() - t0)
+
+    def step_state():
+        s = eng.metrics.histogram("decode_step_time_sec").snapshot()
+        return (s.get("count", 0), s.get("sum", 0.0))
+
+    try:
+        # warm at FULL length, twice: the first pass compiles every
+        # block-table bucket the timed rounds will touch, the second
+        # settles the trie/pool into the steady recycle state — without
+        # this the first (disarmed) timed round absorbs the one-time
+        # costs and the A/B is an order artifact
+        run_once()
+        run_once()
+        dis_n = arm_n = 0
+        dis_s = arm_s = 0.0
+        tps_dis = tps_arm = 0.0
+        for _ in range(rounds):  # interleaved A/B (host-drift-fair)
+            s0 = step_state()
+            tps_dis = max(tps_dis, run_once())
+            s1 = step_state()
+            dis_n += s1[0] - s0[0]
+            dis_s += s1[1] - s0[1]
+            # crosscheck off: blocks PUBLISHED in a disarmed round may
+            # be evicted inside this armed window (an unmatched -1);
+            # this bench measures cost, the balance gates live in tests
+            with resource_ledger(crosscheck=False):
+                s0 = step_state()
+                tps_arm = max(tps_arm, run_once())
+                s1 = step_state()
+            arm_n += s1[0] - s0[0]
+            arm_s += s1[1] - s0[1]
+        mean_dis = dis_s / max(1, dis_n)
+        mean_arm = arm_s / max(1, arm_n)
+    finally:
+        eng.stop()
+    # raw per-note seam cost (the unit the ratio is built of)
+    n_ops = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_ops):
+        ledger_note("pool_block", "bench", +1)  # disarmed: dict test
+    t_dis = time.perf_counter() - t0
+    with resource_ledger(crosscheck=False):
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            ledger_note("pool_block", "bench", +1)
+        t_arm = time.perf_counter() - t0
+    return {
+        "tokens_per_sec_disarmed": round(tps_dis, 1),
+        "tokens_per_sec_armed": round(tps_arm, 1),
+        "wall_throughput_ratio": round(tps_arm / tps_dis, 4),
+        "step_ms_disarmed": round(mean_dis * 1e3, 4),
+        "step_ms_armed": round(mean_arm * 1e3, 4),
+        "step_time_ratio": round(mean_dis / mean_arm, 4),
+        "seam_ns_disarmed": round(1e9 * t_dis / n_ops),
+        "seam_ns_armed": round(1e9 * t_arm / n_ops),
+        "note": f"{n_reqs} concurrent {prompt_len}-token prompts x "
+                f"{new_tokens} greedy tokens on a 2-block d{d_model} LM, "
+                f"4 slots, paged pool ({pool_blocks} blocks); one engine "
+                f"alternating disarmed/armed resource_ledger rounds, "
+                f"best-of-{rounds} interleaved. Floor: step_time_ratio "
+                "(disarmed/armed mean scheduler-iteration time) >= 0.98 "
+                "— the disarmed seams are production-resident, arming "
+                "is the audit state tests use",
+    }
+
+
 def bench_chaos_recovery(prompt_len=48, new_tokens=16, chunk=16, vocab=64,
                          n_reqs=6, max_waves=40, crash_p=0.01) -> dict:
     """Fault-tolerance cost A/B (ISSUE 7): the SAME supervised decode
@@ -2450,6 +2567,12 @@ def main() -> None:
         WORKLOADS["race_audit"] = bench_race_audit()
     except Exception as e:
         WORKLOADS["race_audit"] = {"error": str(e)}
+
+    # ---- analysis: resource-ledger seam-cost A/B (ISSUE 18) -------------
+    try:
+        WORKLOADS["ledger_overhead"] = bench_ledger_overhead()
+    except Exception as e:
+        WORKLOADS["ledger_overhead"] = {"error": str(e)}
 
     try:
         WORKLOADS["speculative_decode"] = bench_speculative_decode()

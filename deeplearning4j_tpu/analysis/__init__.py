@@ -33,16 +33,20 @@ console script). Docs: ``docs/static_analysis.md``.
 """
 from .core import Baseline, Finding, Linter, ModuleInfo, Rule, load_modules
 from .races import RaceDetector, VectorClock, race_audit
-from .runtime import (CompileCounter, LockAuditor, crosscheck_lock_order,
-                      device_index, device_residency, host_read, lock_audit)
+from .runtime import (CompileCounter, LockAuditor, ResourceLedger,
+                      crosscheck_ledger, crosscheck_lock_order,
+                      device_index, device_residency, host_read,
+                      ledger_note, lock_audit, resource_ledger)
 
 __all__ = [
     "Baseline", "Finding", "Linter", "ModuleInfo", "Rule", "load_modules",
     "CompileCounter", "LockAuditor", "crosscheck_lock_order",
     "device_index", "device_residency", "host_read", "lock_audit",
     "RaceDetector", "VectorClock", "race_audit",
+    "ResourceLedger", "crosscheck_ledger", "ledger_note",
+    "resource_ledger",
     "all_rules", "jax_rule_pack", "concurrency_rule_pack",
-    "race_rule_pack",
+    "race_rule_pack", "lifecycle_rule_pack",
 ]
 
 
@@ -61,5 +65,11 @@ def race_rule_pack():
     return [r() for r in RULES]
 
 
+def lifecycle_rule_pack():
+    from .lifecycle import RULES
+    return [r() for r in RULES]
+
+
 def all_rules():
-    return jax_rule_pack() + concurrency_rule_pack() + race_rule_pack()
+    return (jax_rule_pack() + concurrency_rule_pack() + race_rule_pack()
+            + lifecycle_rule_pack())

@@ -1,8 +1,9 @@
 """graftlint CLI.
 
     python -m deeplearning4j_tpu.analysis.lint [paths...]
-        [--format text|json] [--baseline FILE] [--update-baseline]
-        [--no-baseline] [--select JG001,CC005,...] [--ignore CC004,...]
+        [--format text|json|sarif] [--baseline FILE] [--update-baseline]
+        [--no-baseline] [--strict-baseline]
+        [--select JG001,CC005,LC001,...] [--ignore CC004,...]
 
 Defaults: paths = the installed ``deeplearning4j_tpu`` package directory,
 baseline = the committed ``analysis/baseline.json``. Exit codes: 0 clean
@@ -37,10 +38,15 @@ _EXIT_DOC = """exit codes:
   2  usage error (conflicting flags, unknown rule ids)
 
 rule packs: JG001-JG007 (JAX trace/hot-loop discipline), CC001-CC004
-(lock ordering/atomicity), CC005-CC006 (lockset data-race detection).
+(lock ordering/atomicity), CC005-CC006 (lockset data-race detection),
+LC001-LC004 (resource lifecycle: leak-on-path, double-release,
+lock-free handle store, accept-without-terminal).
 To accept a finding deliberately: annotate the line
 `# graftlint: disable=<RULE>` with a rationale, or re-run with
---update-baseline and commit the reviewed ledger diff."""
+--update-baseline and commit the reviewed ledger diff.
+--strict-baseline additionally fails the run when any baseline entry
+still carries the auto-generated TODO justification — the ledger may
+hold debt, but only debt someone has signed off on."""
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -53,11 +59,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*", type=Path,
                    default=None, help="files/dirs to lint "
                    "(default: the deeplearning4j_tpu package)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text",
+                   help="text (human), json (full dump), sarif "
+                        "(2.1.0 interchange for CI annotation)")
     p.add_argument("--baseline", type=Path, default=None,
                    help=f"baseline ledger (default: {_DEFAULT_BASELINE})")
     p.add_argument("--no-baseline", action="store_true",
                    help="report every finding, ignore the ledger")
+    p.add_argument("--strict-baseline", action="store_true",
+                   help="fail if any baseline entry still carries the "
+                        "auto-generated TODO justification (unreviewed "
+                        "debt is not accepted debt)")
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the ledger from current findings "
                         "(justifications of surviving entries carry over)")
@@ -70,12 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def run_lint(paths: Optional[Sequence[Path]] = None,
-             rules: Optional[Sequence[str]] = None,
-             ignore: Optional[Sequence[str]] = None):
-    """(findings, errors) over the given paths — the programmatic entry
-    the CI gate test uses. Unknown rule ids raise (a typo'd --select /
-    --ignore must not produce a vacuously clean run)."""
+def select_rules(rules: Optional[Sequence[str]] = None,
+                 ignore: Optional[Sequence[str]] = None):
+    """Resolve --select/--ignore to concrete Rule objects. Unknown rule
+    ids raise (a typo'd --select / --ignore must not produce a vacuously
+    clean run)."""
     selected = all_rules()
     known = {r.id for r in selected}
     if rules:
@@ -95,8 +107,65 @@ def run_lint(paths: Optional[Sequence[Path]] = None,
     if not selected:
         raise ValueError("rule selection is empty (--select minus "
                          "--ignore left nothing to run)")
-    linter = Linter(selected)
+    return selected
+
+
+def run_lint(paths: Optional[Sequence[Path]] = None,
+             rules: Optional[Sequence[str]] = None,
+             ignore: Optional[Sequence[str]] = None):
+    """(findings, errors) over the given paths — the programmatic entry
+    the CI gate test uses."""
+    linter = Linter(select_rules(rules, ignore))
     return linter.run(list(paths) if paths else [_DEFAULT_TARGET])
+
+
+def render_sarif(findings, new, errors, rules) -> dict:
+    """SARIF 2.1.0 log for the run. Baselined findings are emitted at
+    level ``note`` and new ones at ``error`` so CI annotators surface
+    exactly the findings that gate; the stable graftlint fingerprint
+    rides in partialFingerprints so downstream dedup matches the
+    baseline's identity, not SARIF's default location hash."""
+    new_ids = {id(f) for f in new}
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error" if id(f) in new_ids else "note",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+                "logicalLocations": (
+                    [{"fullyQualifiedName": f.symbol}] if f.symbol else []),
+            }],
+            "partialFingerprints": {"graftlint/v1": f.fingerprint},
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://example.invalid/deeplearning4j_tpu",
+                "rules": [{
+                    "id": r.id,
+                    "name": r.name,
+                    "shortDescription": {"text": r.description or r.name},
+                } for r in rules],
+            }},
+            "results": results,
+            "invocations": [{
+                "executionSuccessful": not errors,
+                "toolExecutionNotifications": [
+                    {"level": "error", "message": {"text": e}}
+                    for e in errors],
+            }],
+        }],
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -120,10 +189,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ignore = args.ignore.split(",") if args.ignore else None
     paths = args.paths if args.paths else None
     try:
-        findings, errors = run_lint(paths, rules, ignore)
+        selected = select_rules(rules, ignore)
     except ValueError as e:  # typo'd --select/--ignore: refuse
         print(str(e), file=sys.stderr)
         return 2
+    findings, errors = Linter(selected).run(
+        list(paths) if paths else [_DEFAULT_TARGET])
 
     baseline_path = args.baseline or _DEFAULT_BASELINE
     if args.update_baseline:
@@ -139,7 +210,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         baseline = Baseline.load(baseline_path)
     new, fixed = baseline.diff(findings)
 
-    if args.format == "json":
+    stale = []
+    if args.strict_baseline:
+        stale = sorted(
+            fp for fp, e in baseline.entries.items()
+            if str(e.get("justification", "")).strip().startswith("TODO"))
+
+    if args.format == "sarif":
+        print(json.dumps(render_sarif(findings, new, errors, selected),
+                         indent=1))
+    elif args.format == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
             "new": [f.to_dict() for f in new],
@@ -159,7 +239,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "fire — regenerate the baseline to retire them")
         print(f"{len(findings)} finding(s): {len(findings) - len(new)} "
               f"baselined, {len(new)} new")
-    return 1 if (new or errors) else 0
+    if stale and args.format != "sarif":
+        print(f"strict-baseline: {len(stale)} entr"
+              f"{'y' if len(stale) == 1 else 'ies'} with unreviewed TODO "
+              f"justification: {', '.join(stale)}", file=sys.stderr)
+    return 1 if (new or errors or stale) else 0
 
 
 if __name__ == "__main__":

@@ -451,3 +451,218 @@ def crosscheck_lock_order(observed_edges, graph
                if observed_part else ""))
     unmodeled = sorted(e for e in mapped if e not in graph.edge_set)
     return violations, unmodeled
+
+
+# -- resource ledger (graftleak's runtime half) ----------------------------
+# The static lifecycle pass (`analysis/lifecycle.py`) proves the acquire/
+# release pairing on paths the AST can see; this ledger proves it on the
+# paths a real run actually takes. The engine, kv pool users, mask pool
+# users, journal, and fork-group code plant `ledger_note(kind, key, ±1)`
+# seams at every acquire/release/transfer site the static registry
+# models, keyed by request id. Balances are asserted zero at request end
+# (`ledger_check_request`) and at engine/router stop
+# (`ledger_check_zero`), and the observed kinds are cross-checked
+# against the static registry (`crosscheck_ledger`) — a runtime acquire
+# of a kind the static pass does not model FAILS the audit, the same
+# discipline as `crosscheck_lock_order`.
+#
+# Disarmed cost is one module-level dict emptiness test per seam, the
+# exact `failpoints.fire()` fast-path shape — safe to leave in the
+# production hot loop. Everything else runs under locks.
+
+_LEDGERS: Dict[int, "ResourceLedger"] = {}
+_ledgers_lock = threading.Lock()
+
+
+class ResourceLedger:
+    """Balance sheet of (resource kind, request key) acquisitions.
+
+    ``note`` never raises on the noting thread (a broken balance must
+    not crash the scheduler mid-request) — violations accumulate and
+    the owning test calls :meth:`assert_clean` at the end.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._balances: Dict[Tuple[str, str], int] = {}
+        self._kinds: Dict[str, List[int]] = {}  # kind -> [acquires, releases]
+        self.violations: List[str] = []
+        self._reported: Set[Tuple[str, str]] = set()
+
+    def note(self, kind: str, key: str, delta: int) -> None:
+        with self._lock:
+            k = (kind, str(key))
+            c = self._kinds.setdefault(kind, [0, 0])
+            if delta > 0:
+                c[0] += delta
+            else:
+                c[1] += -delta
+            bal = self._balances.get(k, 0) + int(delta)
+            if bal == 0:
+                self._balances.pop(k, None)
+                return
+            self._balances[k] = bal
+            if bal < 0 and k not in self._reported:
+                self._reported.add(k)
+                self.violations.append(
+                    f"over-release: {kind} for request {key!r} went to "
+                    f"{bal} (released more than acquired)")
+
+    def check_request(self, key: str, kinds=None) -> None:
+        """Request-end invariant: every kind's balance for ``key`` is
+        zero. Nonzero balances are recorded (and cleared, so an engine
+        stop does not re-report the same debt) as violations.
+        ``kinds``: restrict the judgment to the caller's OWN kinds —
+        the engine retiring a request must not judge the router's
+        still-open journal record for the same request id."""
+        key = str(key)
+        with self._lock:
+            bad = [(k, b) for k, b in self._balances.items()
+                   if k[1] == key and (kinds is None or k[0] in kinds)]
+            for k, b in bad:
+                self._balances.pop(k, None)
+                if k not in self._reported:
+                    self._reported.add(k)
+                    self.violations.append(
+                        f"leak at request end: {k[0]} balance {b:+d} "
+                        f"for request {key!r}")
+
+    def check_zero(self, scope: str, kinds=None) -> None:
+        """Stop-time invariant (engine.stop / router.close): nothing is
+        left acquired anywhere. ``kinds`` scopes the judgment like
+        :meth:`check_request` (an engine stop judges engine kinds; a
+        router close judges its journal records)."""
+        with self._lock:
+            for k, b in sorted(self._balances.items()):
+                if kinds is not None and k[0] not in kinds:
+                    continue
+                self._balances.pop(k, None)
+                if k not in self._reported:
+                    self._reported.add(k)
+                    self.violations.append(
+                        f"leak at {scope}: {k[0]} balance {b:+d} for "
+                        f"request {k[1]!r}")
+
+    def forget(self, key: str, kinds=None) -> None:
+        """Disown one request's balances WITHOUT judging them — the
+        fenced-engine path: a supervisor declared the engine dead and
+        requeued the request onto a replacement; the dead engine's pool
+        (and every block/pin in it) is garbage-collected wholesale, so
+        its per-request debt is not a leak. ``kinds`` scopes the
+        disowning like :meth:`check_request`."""
+        key = str(key)
+        with self._lock:
+            for k in [k for k in self._balances
+                      if k[1] == key and (kinds is None or k[0] in kinds)]:
+                self._balances.pop(k, None)
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                "balances": {f"{k}:{key}": b for (k, key), b
+                             in sorted(self._balances.items())},
+                "kinds": {k: {"acquires": c[0], "releases": c[1]}
+                          for k, c in sorted(self._kinds.items())},
+            }
+
+    def observed_kinds(self) -> Set[str]:
+        with self._lock:
+            return set(self._kinds)
+
+    def assert_clean(self) -> None:
+        """Final gate for tests: zero balances AND zero recorded
+        violations, with the whole charge sheet in the message."""
+        with self._lock:
+            self.violations.extend(
+                f"unchecked residue: {k[0]} balance {b:+d} for request "
+                f"{k[1]!r}" for k, b in sorted(self._balances.items()))
+            self._balances.clear()
+            charges = list(self.violations)
+        if charges:
+            raise AssertionError(
+                "resource ledger is not balanced:\n  "
+                + "\n  ".join(charges))
+
+
+def ledger_note(kind: str, key: str, delta: int) -> None:
+    """The seam call. Disarmed: one dict emptiness test, nothing else
+    (the failpoints.fire fast-path discipline — GIL-atomic read; a note
+    racing an arm either sees it or misses that one event, and tests
+    arm the ledger before starting the engine)."""
+    if not _LEDGERS:  # graftlint: disable=CC005
+        return
+    with _ledgers_lock:
+        ledgers = list(_LEDGERS.values())
+    for led in ledgers:
+        led.note(kind, key, delta)
+
+
+def ledger_check_request(key: str, kinds=None) -> None:
+    """Request-end seam (engine retire/evict/fail paths)."""
+    if not _LEDGERS:  # graftlint: disable=CC005
+        return
+    with _ledgers_lock:
+        ledgers = list(_LEDGERS.values())
+    for led in ledgers:
+        led.check_request(key, kinds)
+
+
+def ledger_check_zero(scope: str, kinds=None) -> None:
+    """Stop-time seam (engine.stop / router.close)."""
+    if not _LEDGERS:  # graftlint: disable=CC005
+        return
+    with _ledgers_lock:
+        ledgers = list(_LEDGERS.values())
+    for led in ledgers:
+        led.check_zero(scope, kinds)
+
+
+def ledger_forget(key: str, kinds=None) -> None:
+    """Fence/crash-recovery seam: disown a request's balances."""
+    if not _LEDGERS:  # graftlint: disable=CC005
+        return
+    with _ledgers_lock:
+        ledgers = list(_LEDGERS.values())
+    for led in ledgers:
+        led.forget(key, kinds)
+
+
+@contextlib.contextmanager
+def resource_ledger(crosscheck: bool = True):
+    """Arm a ResourceLedger for the duration of the context and yield
+    it. On exit the ledger is disarmed and (by default) cross-checked
+    against the static registry — violations accumulate on the ledger;
+    call ``led.assert_clean()`` to judge them."""
+    led = ResourceLedger()
+    with _ledgers_lock:
+        _LEDGERS[id(led)] = led
+    try:
+        yield led
+    finally:
+        with _ledgers_lock:
+            _LEDGERS.pop(id(led), None)
+        if crosscheck:
+            violations, _unmodeled = crosscheck_ledger(led)
+            led.violations.extend(violations)
+
+
+def crosscheck_ledger(ledger: ResourceLedger
+                      ) -> Tuple[List[str], List[str]]:
+    """Join the runtime-observed resource kinds against the static
+    lifecycle registry (lazy import — hot-path modules import this
+    module, and must not drag the AST machinery in).
+
+    Returns (violations, silent_kinds): a kind the runtime observed
+    that the static registry does not model is a VIOLATION (an
+    unmodeled acquire site — the static pass is blind to it, so the
+    two-sided guarantee is broken); a registered kind the run never
+    exercised is merely reported as silent (workloads differ)."""
+    from .lifecycle import registry_kinds
+    known = registry_kinds()
+    observed = ledger.observed_kinds()
+    violations = [
+        f"unmodeled resource kind {k!r}: runtime seams note it, but "
+        f"the static lifecycle registry does not model it"
+        for k in sorted(observed - known)]
+    silent = sorted(known - observed)
+    return violations, silent

@@ -96,7 +96,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis.runtime import CompileCounter, device_index, host_read
+from ..analysis.runtime import (CompileCounter, device_index, host_read,
+                                ledger_check_request, ledger_check_zero,
+                                ledger_forget, ledger_note)
 from ..models.sampling import sample_logits
 from ..nn.layers.recurrent import (BaseRecurrentImpl,
                                    _materialize_rnn_states)
@@ -118,6 +120,14 @@ from .trace import FlightRecorder, default_recorder, new_request_id
 # small program instead of compiling a 3-wide one-off); buckets smaller
 # than 16 only exist when prefill_chunk itself is smaller
 _MIN_CHUNK_BUCKET = 16
+
+# the resource kinds THIS module's ledger seams own (graftleak's runtime
+# half, `analysis.runtime.resource_ledger`): request-end and stop-time
+# balance checks judge only these, so an in-process router's still-open
+# journal record for the same request id is never misread as an engine
+# leak
+_LEDGER_KINDS = frozenset(
+    ("trie_pin", "pool_block", "mask_row", "engine_slot"))
 
 
 class _EngineFenced(Exception):
@@ -1631,6 +1641,8 @@ class DecodeScheduler:
             return
         n_blk, ids, node = self.pool.match(seq.prompt, max_hit)
         seq.pool_node = node  # holds one reference until the slot frees
+        if node is not None:
+            ledger_note("trie_pin", seq.handle.request_id, +1)
         if not n_blk:
             return
         bucket = bucket_for(n_blk, self.restore_buckets)
@@ -1651,6 +1663,7 @@ class DecodeScheduler:
         if seq.pool_node is not None:
             self.pool.release(seq.pool_node)
             seq.pool_node = None
+            ledger_note("trie_pin", seq.handle.request_id, -1)
 
     def _publish_prompt(self, slot: int, seq: _ActiveSeq) -> None:
         """Index a finished sequence's prompt: insert its full blocks into
@@ -1702,6 +1715,7 @@ class DecodeScheduler:
         while True:
             bid = self.pool.alloc()
             if bid is not None:
+                ledger_note("pool_block", seq.handle.request_id, +1)
                 return bid
             victim = self._pick_victim()
             if victim is None or victim[1] is seq:
@@ -1827,6 +1841,7 @@ class DecodeScheduler:
         # read the list reference GIL-atomically and tolerate a one-
         # entry-stale view — CC005 cannot see the single-writer protocol
         self._slots[slot] = None  # graftlint: disable=CC004,CC005
+        ledger_note("engine_slot", h.request_id, -1)
         with self._cond:
             self._queue.insert(0, seq)
             self._m_queue_depth.set(len(self._queue))
@@ -1838,9 +1853,13 @@ class DecodeScheduler:
         trie-owned — releasing the trie pin is `_release_pool`'s job)
         and reset its table row to scratch. ``keep``: ids adopted by the
         trie at publish (ownership already transferred)."""
+        freed = 0
         for bid, sh in zip(seq.block_ids, seq.shared):
             if not sh and bid not in keep:
                 self.pool.free_block(bid)
+                freed += 1
+        if freed:
+            ledger_note("pool_block", seq.handle.request_id, -freed)
         seq.block_ids = []
         seq.shared = []
         self._table[slot, :] = SCRATCH_BLOCK
@@ -1870,6 +1889,8 @@ class DecodeScheduler:
             return
         n_blk, ids, node = self.pool.match(seq.prompt, max_hit)
         seq.pool_node = node  # holds one reference until the slot frees
+        if node is not None:
+            ledger_note("trie_pin", seq.handle.request_id, +1)
         if not n_blk:
             return
         seq.block_ids = [int(b) for b in ids]
@@ -1909,8 +1930,14 @@ class DecodeScheduler:
         n_full = len(seq.prompt) // B
         if n_full < 1 or n_full > len(seq.block_ids):
             return frozenset()
-        return frozenset(self.pool.adopt(
+        adopted = frozenset(self.pool.adopt(
             seq.prompt[:n_full * B], seq.block_ids[:n_full]))
+        if adopted:
+            # ownership transfer: the trie owns these pages now — the
+            # request's debt is settled without a free_block
+            ledger_note("pool_block", seq.handle.request_id,
+                        -len(adopted))
+        return adopted
 
     # -- grammar mask residency (logitproc.MaskPool) -----------------------
     def _attach_mask(self, slot: int, seq: _ActiveSeq) -> None:
@@ -1942,6 +1969,7 @@ class DecodeScheduler:
                 self._masks, self._dev_index(start),
                 self._dev_array(rows))
         proc.mask_base = start
+        ledger_note("mask_row", seq.handle.request_id, +1)
         self._m_mask_rows.set(self.maskpool.resident_rows())
         if self.tracer.enabled:
             self.tracer.instant(
@@ -1959,6 +1987,7 @@ class DecodeScheduler:
         if proc is not None and proc.mask_base is not None:
             self.maskpool.release(proc.grammar.key)
             proc.mask_base = None
+            ledger_note("mask_row", seq.handle.request_id, -1)
             self._m_mask_rows.set(self.maskpool.resident_rows())
 
     # -- client side -------------------------------------------------------
@@ -2173,6 +2202,13 @@ class DecodeScheduler:
                 self._thread = None
             # safe lock-free: the loop thread is joined (or, if it is a
             # hung zombie, exits at its fence check without writing)
+            for seq in self._slots:  # graftlint: disable=CC004
+                if seq is not None:
+                    # disown, don't judge: the supervisor requeued this
+                    # request onto a replacement engine, and this dead
+                    # engine's pool (pins, blocks, mask rows and all)
+                    # is garbage-collected wholesale
+                    ledger_forget(seq.handle.request_id, _LEDGER_KINDS)
             self._slots = [None] * self.n_slots  # graftlint: disable=CC004
             return
         with self._cond:
@@ -2209,6 +2245,8 @@ class DecodeScheduler:
                 seq.handle._finish(RuntimeError("scheduler stopped"))
                 self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
+                ledger_note("engine_slot", seq.handle.request_id, -1)
+        ledger_check_zero("engine.stop", _LEDGER_KINDS)
 
     # -- scheduler loop ----------------------------------------------------
     def _trace_done(self, outcome: str, seq: _ActiveSeq,
@@ -2270,6 +2308,9 @@ class DecodeScheduler:
                 seq.handle._finish()  # partial tokens, caller already left
                 self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
+                ledger_note("engine_slot", seq.handle.request_id, -1)
+                ledger_check_request(seq.handle.request_id,
+                                     _LEDGER_KINDS)
 
     def _pool_can_admit(self, seq: _ActiveSeq,
                         reclaim_memo: List[Optional[int]],
@@ -2346,6 +2387,7 @@ class DecodeScheduler:
                         break
                     self._queue.pop(qi)
                     self._slots[i] = seq
+                    ledger_note("engine_slot", seq.handle.request_id, +1)
                     if self.paged:
                         pending_blocks += self._blocks_for(len(seq.prompt))
                     if not seq.resumed:
@@ -2436,6 +2478,8 @@ class DecodeScheduler:
             n_full = len(seq.prompt) // self.pool.block
             _, _, node = self.pool.match(seq.prompt, n_full)
             seq.pool_node = node
+            if node is not None:
+                ledger_note("trie_pin", seq.handle.request_id, +1)
             if self.tracer.enabled:
                 self.tracer.instant(
                     "fork", track=self._slot_tracks[slot],
@@ -2549,6 +2593,8 @@ class DecodeScheduler:
         self._trace_done("finish", seq, slot=slot)
         self._m_latency.record(now - h.t_submit)
         self._slots[slot] = None
+        ledger_note("engine_slot", h.request_id, -1)
+        ledger_check_request(h.request_id, _LEDGER_KINDS)
 
     def _run_prefill_chunk(self) -> Optional[int]:
         """At most one bounded prefill chunk per iteration (round-robin
@@ -2697,14 +2743,17 @@ class DecodeScheduler:
         never extend past the write frontier, but the guard keeps a
         refcount leak structurally impossible. Returns blocks freed."""
         need = self._blocks_for(seq.written)
-        freed = 0
+        freed = owned = 0
         while len(seq.block_ids) > need:
             bid = seq.block_ids.pop()
             sh = seq.shared.pop()
             self._table[slot, len(seq.block_ids)] = SCRATCH_BLOCK
             if not sh:
                 self.pool.free_block(bid)
+                owned += 1
             freed += 1
+        if owned:
+            ledger_note("pool_block", seq.handle.request_id, -owned)
         return freed
 
     def _run_speculation(self, spec: List[Tuple[int, _ActiveSeq]]) -> None:
@@ -3107,6 +3156,18 @@ class DecodeScheduler:
                       "iterations": self.iterations})
         if self._on_crash is not None:
             self._close_request_spans()
+            # supervised crash: the handles stay open and the supervisor
+            # requeues them — this engine's per-request resource debt
+            # dies with its pool, so the ledger disowns it (the
+            # replacement engine re-acquires under the same request ids
+            # from a clean balance)
+            for seq in self._slots:  # graftlint: disable=CC004
+                if seq is not None:
+                    ledger_forget(seq.handle.request_id, _LEDGER_KINDS)
+            with self._cond:
+                queued = self._queue[:]
+            for seq in queued:
+                ledger_forget(seq.handle.request_id, _LEDGER_KINDS)
             self._on_crash(exc)
         else:
             self._fail_all_inflight(EngineCrashedError(
@@ -3153,6 +3214,9 @@ class DecodeScheduler:
                 seq.handle._finish(exc)
                 self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
+                ledger_note("engine_slot", seq.handle.request_id, -1)
+                ledger_check_request(seq.handle.request_id,
+                                     _LEDGER_KINDS)
         self._m_active.set(0)
 
     def _close_request_spans(self) -> None:

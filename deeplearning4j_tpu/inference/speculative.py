@@ -46,6 +46,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis.runtime import ledger_note
 from ..models.sampling import sample_logits
 
 
@@ -100,15 +101,23 @@ def submit_fork_group(submit: Callable, prompt_ids: Sequence[int], n: int,
     decoding into handles nobody holds."""
     group = ForkGroup(n)
     handles: List = []
+    key = None
     try:
         for i in range(n):
             handles.append(submit(
                 prompt_ids, max_new_tokens, seed=seed + i, fork=group,
                 request_id=f"{request_id}.c{i}" if request_id else None,
                 **kw))
+            # fork-group membership ref (graftleak's runtime ledger):
+            # one per submitted candidate, keyed by the group's primary
+            # so the engine's per-candidate request-end checks skip it
+            if key is None:
+                key = f"fork:{handles[0].request_id}"
+            ledger_note("fork_ref", key, +1)
     except BaseException:
         for h in handles:
             h.cancel()
+            ledger_note("fork_ref", key, -1)
         raise
     return handles
 
@@ -119,15 +128,26 @@ def await_fork_group(handles: Sequence, timeout: Optional[float],
     cancels all unfinished candidates before propagating (the other
     half of the submission protocol shared by engine and supervisor)."""
     deadline = (clock() + timeout) if timeout is not None else None
+    key = (f"fork:{handles[0].request_id}" if len(handles) else None)
+    released = 0
     try:
         for h in handles:
             h.result(None if deadline is None
                      else max(0.0, deadline - clock()))
+            released += 1
+            ledger_note("fork_ref", key, -1)
     except TimeoutError:
         for h in handles:
             if not h.done():
                 h.cancel()
         raise
+    finally:
+        # the awaiter's refs drop with the await on EVERY exit —
+        # settled, timed out + cancelled, or failed (engine crash mid-
+        # await). The cancelled candidates' slot/pool debt is the
+        # ENGINE's ledger entry under their own request ids, not this.
+        for h in handles[released:]:
+            ledger_note("fork_ref", key, -1)
 
 
 def accept_tokens(rows: np.ndarray, proposals: Sequence[int],

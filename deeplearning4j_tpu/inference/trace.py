@@ -470,7 +470,8 @@ def main(argv=None) -> int:
     url = f"{args.url.rstrip('/')}/trace?format=chrome"
     if args.limit:
         url += f"&limit={args.limit}"
-    trace = json.loads(urllib.request.urlopen(url).read())
+    with urllib.request.urlopen(url) as resp:
+        trace = json.loads(resp.read())
     with open(args.out, "w") as fh:
         json.dump(trace, fh)
     n = len(trace.get("traceEvents", []))

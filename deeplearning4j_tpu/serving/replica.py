@@ -417,7 +417,9 @@ class ReplicaSupervisor:
         try:
             req = urllib.request.Request(r.base_url + "/admin/drain",
                                          data=b"{}", method="POST")
-            urllib.request.urlopen(req, timeout=self.probe_timeout_s).read()
+            with urllib.request.urlopen(
+                    req, timeout=self.probe_timeout_s) as resp:
+                resp.read()
         except (OSError, urllib.error.URLError):
             return False
         t0 = time.monotonic()

@@ -85,6 +85,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..analysis.runtime import (ledger_check_request, ledger_forget,
+                                ledger_note)
 from ..inference import failpoints
 from ..inference.metrics import MetricsRegistry
 from ..inference.profiler import SLOMonitor, burn_verdict
@@ -100,6 +102,11 @@ __all__ = ["FleetRouter", "RequestJournal", "ReplicaEndpoint",
            "affinity_key", "pick_replica", "NoReplicaError", "main"]
 
 _REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._:\-]{1,128}")
+
+# the resource kind the router's ledger seams own (graftleak's runtime
+# half): journal records only — engine kinds for the same request id
+# belong to the replica's DecodeScheduler, never judged here
+_JOURNAL_KINDS = frozenset(("journal_record",))
 
 
 class NoReplicaError(RuntimeError):
@@ -190,8 +197,16 @@ class RequestJournal:
                     break
                 for rec in recs:
                     self._ingest(rec, accepts)
-            return [accepts[rid] for rid in accepts
-                    if rid not in self._terminal]
+            recovered = [accepts[rid] for rid in accepts
+                         if rid not in self._terminal]
+            for rec in recovered:
+                # this incarnation inherits the open obligation: clear
+                # any stale balance a crashed same-process predecessor
+                # left (its accept was its own debt), then re-open it —
+                # the replay's terminal record settles it
+                ledger_forget(rec["rid"], _JOURNAL_KINDS)
+                ledger_note("journal_record", rec["rid"], +1)
+            return recovered
 
     def _ingest(self, rec: dict, accepts: Optional[dict] = None) -> None:
         # caller holds self._lock
@@ -212,6 +227,7 @@ class RequestJournal:
             self._producer.send({"t": "accept", "rid": rid, "req": req,
                                  "path": path, "ts": time.time()})
             self.accepted_total += 1
+            ledger_note("journal_record", rid, +1)
 
     def _terminate(self, rid: str, rec: dict) -> bool:
         with self._lock:
@@ -226,6 +242,7 @@ class RequestJournal:
                 return False
             self._producer.send(rec)
             self._terminal.add(rid)
+            ledger_note("journal_record", rid, -1)
             return True
 
     def finish(self, rid: str, tokens=None, replica: Optional[str] = None,
@@ -872,6 +889,12 @@ class FleetRouter:
                     self._send({"error": str(e), "request_id": rid}, 400,
                                request_id=rid)
                 finally:
+                    if url.path == "/generate":
+                        # request-end ledger invariant: whatever path
+                        # answered the client (success, propagated
+                        # error, injected fault), the journal record
+                        # must have reached its terminal by now
+                        ledger_check_request(rid, _JOURNAL_KINDS)
                     if ctx is not None:
                         router.tracer.end("rpc", req=rid)
                     if slo_sample and url.path in ("/generate", "/predict",

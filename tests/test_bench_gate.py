@@ -136,6 +136,27 @@ def test_profiler_overhead_healthy_row_passes():
     assert bench.check_floors(rows) == []
 
 
+def test_ledger_overhead_regression_is_caught():
+    """ISSUE 18 acceptance floor: the graftleak resource-ledger seams
+    ride the decode hot loop permanently (disarmed = one dict emptiness
+    test per note). The armed engine's mean step time sliding below 98%
+    of the disarmed one's — someone adding a lock, an allocation, or a
+    string format to the DISARMED fast path would depress the ratio's
+    denominator the same way — must trip the gate, as must the field
+    going missing."""
+    regs = bench.check_floors(
+        {"ledger_overhead": {"step_time_ratio": 0.9}})
+    assert any("step_time_ratio=0.9 < floor" in r for r in regs), regs
+    regs = bench.check_floors(
+        {"ledger_overhead": {"wall_throughput_ratio": 1.0}})
+    assert any("missing/non-numeric" in r for r in regs), regs
+
+
+def test_ledger_overhead_healthy_row_passes():
+    rows = {"ledger_overhead": {"step_time_ratio": 1.01}}
+    assert bench.check_floors(rows) == []
+
+
 def test_trace_aggregation_regressions_are_caught():
     """ISSUE 12 acceptance floors: the fleet aggregator tailing two
     replicas must not perturb their scheduler hot loops (per-replica

@@ -328,10 +328,17 @@ def test_runtime_happens_before_checker_zero_violations_under_chaos():
     engine state, and the armed failpoint's trigger counters (under the
     per-arm lock). Deliberately lock-free reviewed suppressions
     (heartbeat, readiness flags, fence) stay unwatched — the dynamic
-    check proves exactly the invariants the static pass accepts."""
+    check proves exactly the invariants the static pass accepts.
+
+    The resource ledger (graftleak) rides the same run: across crash ->
+    fence -> rebuild -> replay, every slot/pin/block the dead engine
+    held is disowned by the fence (its pool is garbage-collected
+    wholesale) and the replacement engine's replay re-acquires and
+    releases its own — the balance sheet must end at zero."""
+    from deeplearning4j_tpu.analysis import resource_ledger
     from deeplearning4j_tpu.analysis.races import race_audit
 
-    with race_audit() as det:
+    with race_audit() as det, resource_ledger() as led:
         srv = InferenceServer(net=_lm(), decode_vocab=V, decode_slots=2,
                               prefill_chunk=16, hang_timeout_s=5.0,
                               retry_budget=6).start()
@@ -372,6 +379,7 @@ def test_runtime_happens_before_checker_zero_violations_under_chaos():
             srv.stop()
     assert det.violations == [], det.format_violations()
     assert det.tracking  # armed throughout, not fast-pathed
+    led.assert_clean()  # crash -> replay leaked no slot/pin/block
 
 
 def test_sharded_engine_crash_recovery_token_identical():

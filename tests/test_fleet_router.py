@@ -381,6 +381,55 @@ def test_replica_504_is_terminal_not_failed_over(tmp_path):
         ok_rep.stop()
 
 
+def test_journal_replay_balances_resource_ledger(tmp_path):
+    """Crash -> replay under the armed resource ledger (graftleak): a
+    predecessor's journal holds an accept with no terminal record (it
+    died mid-dispatch). The next incarnation's recover() inherits the
+    open obligation (+1 on its ledger), the replay's finish settles it,
+    and a live request's accept/finish pair balances too — journal
+    records leak exactly never, across the crash boundary included."""
+    from deeplearning4j_tpu.analysis import resource_ledger
+    from deeplearning4j_tpu.serving.router import RequestJournal
+
+    jpath = str(tmp_path / "j.log")
+    # the crashed incarnation: accept journaled, no terminal record.
+    # (Built BEFORE arming, exactly like a dead process's file.)
+    j = RequestJournal(jpath)
+    j.accept("req-inherited", {"prompt": [1, 2, 3], "max_new_tokens": 2})
+    j.close()
+
+    ok_rep = _StubReplica(ready=True)
+    with resource_ledger() as led:
+        sup = ReplicaSupervisor([ReplicaEndpoint(ok_rep.url, "r0")],
+                                poll_interval_s=0.05,
+                                metrics=MetricsRegistry())
+        router = FleetRouter(supervisor=sup, quorum=1, journal_path=jpath,
+                             scrape_interval_s=0.05).start()
+        try:
+            body = json.dumps({"prompt": [4, 5, 6],
+                               "max_new_tokens": 2}).encode()
+            live = _post_retry(router.port, "/generate", body)
+            assert live.get("tokens") is not None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with router._lock:
+                    if router.replayed_total >= 1:
+                        break
+                time.sleep(0.05)
+            with router._lock:
+                assert router.replayed_total == 1
+        finally:
+            router.stop(stop_replicas=False)
+            sup.stop()
+    ok_rep.stop()
+    accepts, finishes, fails = _journal_audit(jpath)
+    assert finishes.get("req-inherited") == 1 and not fails
+    snap = led.snapshot()
+    # both the inherited and the live record were noted and settled
+    assert snap["kinds"]["journal_record"]["acquires"] >= 2
+    led.assert_clean()
+
+
 def test_burning_fleet_rejects_with_retry_after(tmp_path):
     ok_rep = _StubReplica(ready=True)
     sup = ReplicaSupervisor([ReplicaEndpoint(ok_rep.url, "r0")],

@@ -22,7 +22,15 @@ Five layers of enforcement:
      model honest, exactly as layer 3 cross-checks CC001. (The chaos
      variant — crash/restart under the checker — lives in
      tests/test_chaos.py.)
+  Layer 6 (ISSUE 18): the LC resource-lifecycle pass must ALSO run
+  clean with no baseline at all, the CLI gate runs with
+  --strict-baseline so unreviewed TODO ledger entries fail, and
+  tools/lint_gate.sh — the single CI entrypoint over every pack —
+  must exit 0 on the tree as committed.
 """
+import os
+import subprocess
+import sys
 from pathlib import Path
 
 import numpy as np
@@ -30,8 +38,9 @@ import numpy as np
 from deeplearning4j_tpu.analysis import (CompileCounter,
                                          concurrency_rule_pack,
                                          crosscheck_lock_order,
-                                         jax_rule_pack, lock_audit,
-                                         race_audit, race_rule_pack)
+                                         jax_rule_pack, lifecycle_rule_pack,
+                                         lock_audit, race_audit,
+                                         race_rule_pack)
 from deeplearning4j_tpu.analysis.concurrency_rules import (build_lock_graph,
                                                            find_cycle)
 from deeplearning4j_tpu.analysis.core import Baseline, load_modules
@@ -45,10 +54,13 @@ def test_rule_packs_meet_the_contract_floor():
     assert len(jax_rule_pack()) >= 5
     assert len(concurrency_rule_pack()) >= 3
     assert len(race_rule_pack()) >= 2
+    assert len(lifecycle_rule_pack()) == 4
     ids = [r.id for r in jax_rule_pack() + concurrency_rule_pack()
-           + race_rule_pack()]
+           + race_rule_pack() + lifecycle_rule_pack()]
     assert len(ids) == len(set(ids))
     assert {"CC005", "CC006"} <= {r.id for r in race_rule_pack()}
+    assert {"LC001", "LC002", "LC003", "LC004"} == \
+        {r.id for r in lifecycle_rule_pack()}
 
 
 def test_graftlint_clean_against_committed_baseline():
@@ -82,6 +94,43 @@ def test_race_pass_runs_clean_with_no_baseline_at_all():
     baseline = Baseline.load(_DEFAULT_BASELINE)
     assert not any(e["rule"] in ("CC005", "CC006")
                    for e in baseline.entries.values())
+
+
+def test_lifecycle_pass_runs_clean_with_no_baseline_at_all():
+    """ISSUE 18 acceptance: 0 unsuppressed LC001-LC004 findings across
+    the package with NO baseline entries — resource-lifecycle findings
+    in new code gate absolutely, they are never accepted as debt. (The
+    pass earned this bar by finding and fixing two real leaks — an
+    unclosed trace-fetch response body and an unclosed drain probe —
+    before it was turned on.)"""
+    findings, errors = run_lint(rules=["LC001", "LC002", "LC003", "LC004"])
+    assert not errors, errors
+    assert findings == [], "unsuppressed lifecycle findings:\n" + "\n".join(
+        f.format() for f in findings)
+    baseline = Baseline.load(_DEFAULT_BASELINE)
+    assert not any(e["rule"].startswith("LC")
+                   for e in baseline.entries.values())
+
+
+def test_cli_gate_passes_with_strict_baseline():
+    """The CI invocation is `--strict-baseline`: beyond new-finding
+    detection, any committed ledger entry still carrying the
+    auto-generated TODO justification fails the run."""
+    from deeplearning4j_tpu.analysis.lint import main as lint_main
+    assert lint_main(["--strict-baseline"]) == 0
+
+
+def test_lint_gate_script_exits_zero_on_the_committed_tree():
+    """tools/lint_gate.sh is the single CI entrypoint: full packs
+    against the strict baseline plus the LC pack with no baseline.
+    It must pass on the tree as committed."""
+    gate = Path(_DEFAULT_TARGET).parent / "tools" / "lint_gate.sh"
+    assert gate.exists()
+    proc = subprocess.run(
+        ["sh", str(gate)], capture_output=True, text=True,
+        env={**os.environ, "PYTHON": sys.executable})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "lint_gate: clean" in proc.stdout
 
 
 def test_every_baseline_entry_carries_a_reviewed_justification():

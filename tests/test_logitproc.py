@@ -631,6 +631,53 @@ def test_http_stream_disconnect_reclaims_slot_and_pins(server, prompt):
     assert eng.pool.reclaimable_blocks() == reclaim0
 
 
+def test_stream_disconnect_balances_resource_ledger(prompt):
+    """The cancel-on-disconnect path re-run under the armed resource
+    ledger (graftleak): a dedicated server (armed BEFORE start, so
+    every acquisition in the window is noted from birth), one buffered
+    request, one grammar-constrained request (exercising the mask_row
+    seams), then a raw-socket hangup mid-stream — the cancel must
+    drive every slot/pin/block/mask-row balance back to zero, and
+    server stop must find nothing still acquired."""
+    from deeplearning4j_tpu.analysis import resource_ledger
+    with resource_ledger() as led:
+        srv = InferenceServer(net=_lm(), decode_vocab=V, decode_slots=2,
+                              prefill_chunk=16, kv_pool_mb=0.5,
+                              hang_timeout_s=600).start()
+        try:
+            eng = srv._decoder
+            out = _post_json(srv.port, "/generate",
+                             {"prompt": prompt, "max_new_tokens": 6})
+            assert out["tokens"]
+            grammar = _post_json(
+                srv.port, "/generate",
+                {"prompt": prompt, "max_new_tokens": 6,
+                 "grammar": {"type": "admit_all"}})
+            assert grammar["tokens"]
+            s = socket.create_connection(("127.0.0.1", srv.port))
+            body = json.dumps({"prompt": prompt, "max_new_tokens": 100,
+                               "stream": True}).encode()
+            s.sendall(b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: " + str(len(body)).encode()
+                      + b"\r\n\r\n" + body)
+            assert b"200" in s.recv(256)  # the stream started
+            s.close()  # hang up mid-decode
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if (srv.metrics.counter("stream_disconnects_total").value
+                        >= 1 and eng.inflight() == 0):
+                    break
+                time.sleep(0.05)
+            assert eng.inflight() == 0
+            assert eng.pool.outstanding_refs() == 0
+        finally:
+            srv.stop()
+    snap = led.snapshot()
+    assert snap["kinds"]["mask_row"]["acquires"] >= 1  # grammar ran noted
+    led.assert_clean()
+
+
 # -- router: SSE pass-through ----------------------------------------------
 def test_router_pump_distinguishes_death_from_clean_eof(tmp_path):
     """SSE bodies are close-delimited, so a SIGKILLed replica's FIN

@@ -184,7 +184,10 @@ def test_preempt_and_resume_mid_decode_is_token_identical():
     """Two sequences whose decode growth exceeds the pool: the
     latest-submitted slot is swapped out (blocks released, requeued) and
     resumed after the first finishes — outputs identical to solo, swap
-    visible in metrics and trace instants."""
+    visible in metrics and trace instants. Runs under the armed resource
+    ledger (graftleak): the preempt's release-and-requeue and the
+    resume's re-acquire must balance every block/pin/slot to zero."""
+    from deeplearning4j_tpu.analysis import resource_ledger
     net = _lm(cache=96)
     rng = np.random.default_rng(4)
     p1, p2 = [list(rng.integers(0, V, 6)) for _ in range(2)]
@@ -192,18 +195,20 @@ def test_preempt_and_resume_mid_decode_is_token_identical():
     solo2 = generate_transformer(net, p2, 10, V, use_cache=True)
     m = MetricsRegistry()
     tr = FlightRecorder(8192)
-    # each sequence needs ceil((6+10-1)/4) = 4 blocks; 7 cannot hold 8
-    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
-                          kv_pool_mb=_pool_mb(7, 4), kv_block=4,
-                          metrics=m, tracer=tr).start()
-    try:
-        h1 = eng.submit(p1, 10)
-        h2 = eng.submit(p2, 10)
-        assert h1.result(120) == solo1
-        assert h2.result(120) == solo2
-        assert eng.pool.outstanding_refs() == 0
-    finally:
-        eng.stop()
+    with resource_ledger() as led:
+        # each sequence needs ceil((6+10-1)/4) = 4 blocks; 7 cannot hold 8
+        eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                              kv_pool_mb=_pool_mb(7, 4), kv_block=4,
+                              metrics=m, tracer=tr).start()
+        try:
+            h1 = eng.submit(p1, 10)
+            h2 = eng.submit(p2, 10)
+            assert h1.result(120) == solo1
+            assert h2.result(120) == solo2
+            assert eng.pool.outstanding_refs() == 0
+        finally:
+            eng.stop()
+    led.assert_clean()
     assert m.counter("decode_preempted_total").value >= 1
     names = [e["name"] for e in tr.events()]
     assert names.count("preempt") >= 1

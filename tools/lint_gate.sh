@@ -1,0 +1,35 @@
+#!/bin/sh
+# CI lint gate: run every graftlint pack (JAX discipline, concurrency,
+# data races, resource lifecycle) against the committed baseline, with
+# strict-baseline on so unreviewed TODO entries also fail. Exits nonzero
+# on any unbaselined finding. Run from the repo root:
+#
+#   ./tools/lint_gate.sh            # gate the package
+#   ./tools/lint_gate.sh --format sarif > lint.sarif  # CI annotation
+#
+# Extra arguments are passed through to the lint CLI.
+set -u
+
+cd "$(dirname "$0")/.."
+
+PYTHON="${PYTHON:-python}"
+
+# Full-pack run against the committed ledger. --strict-baseline means a
+# baselined finding whose justification is still the auto-generated TODO
+# fails too: the ledger may hold debt, but only reviewed debt.
+status=0
+"$PYTHON" -m deeplearning4j_tpu.analysis.lint --strict-baseline "$@" \
+    || status=$?
+
+# The lifecycle pack must additionally be clean with NO baseline at all:
+# LC rules gate new code absolutely, not modulo accepted debt.
+lc_status=0
+"$PYTHON" -m deeplearning4j_tpu.analysis.lint \
+    --select LC001,LC002,LC003,LC004 --no-baseline --format text \
+    > /dev/null || lc_status=$?
+
+if [ "$status" -ne 0 ] || [ "$lc_status" -ne 0 ]; then
+    echo "lint_gate: FAILED (full=$status lifecycle=$lc_status)" >&2
+    exit 1
+fi
+echo "lint_gate: clean"
