@@ -157,6 +157,26 @@ REGISTRY: Tuple[ResourceSpec, ...] = (
         doc="HTTP/socket response bodies must be closed on every path "
             "(a with-statement counts); an unclosed SSE body strands "
             "the replica-side cancel-on-disconnect."),
+    ResourceSpec(
+        kind="host_page",
+        receivers=("tier", "host"), ledger_only=True,
+        doc="One spilled KV block resident in the TierManager host "
+            "ring — acquired by the worker's host insert, released on "
+            "LRU demotion/drop/stop; keyed by chain hash and balanced "
+            "by the runtime ledger through spill→restore→free."),
+    ResourceSpec(
+        kind="disk_block",
+        receivers=("tier", "disk"), ledger_only=True,
+        doc="One CRC-framed block file in the TierManager disk store "
+            "— acquired at host-overflow demotion, released on disk "
+            "eviction or stop (files persist; the ledger models "
+            "in-process ownership only)."),
+    ResourceSpec(
+        kind="directory_entry",
+        receivers=("tier", "directory"), ledger_only=True,
+        doc="One chain hash tracked in the prefix directory (any "
+            "tier) — acquired at note_resident/insert_fetched, "
+            "released when the block falls off the bottom tier."),
 )
 
 

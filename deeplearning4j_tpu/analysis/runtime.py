@@ -171,6 +171,15 @@ class CompileCounter:
         jcow = getattr(scheduler, "_jcow", None)
         if jcow is not None:
             c.track("block_cow", jcow, budget=1)
+        # KV tiering (ISSUE 19): spill slices and restore writes keep
+        # the block index traced — one program each for the whole tier
+        # ladder, whatever spills or promotes
+        jtspill = getattr(scheduler, "_jtier_spill", None)
+        if jtspill is not None:
+            c.track("tier_spill", jtspill, budget=1)
+        jtrestore = getattr(scheduler, "_jtier_restore", None)
+        if jtrestore is not None:
+            c.track("tier_restore", jtrestore, budget=1)
         # speculative decoding (ISSUE 10): the verify program mirrors
         # decode's bucketing (<=1 per table bucket, one fixed gamma+1
         # chain width — pow2-gamma callers each get their own engine,

@@ -17,6 +17,8 @@ Usage:
                     [--prefill-chunk C] [--kv-pool-mb MB]
                     [--prefix-cache-mb MB] [--kv-block B]
                     [--kv-dtype int8] [--paged-kernel auto|on|off]
+                    [--host-cache-mb MB] [--disk-cache-mb MB]
+                    [--tier-dir DIR]
                     [--mask-rows N] [--speculate GAMMA]
                     [--draft-blocks K] [--tp N]]
                    [--no-supervise] [--hang-timeout S] [--retry-budget N]
@@ -29,6 +31,7 @@ Usage:
                    [--port P] [--quorum Q] [--kv-block B]
                    [--paged-kernel auto|on|off]
                    [--affinity-blocks K] [--replica-arg ARG ...]
+                   [--no-prefix-directory] [--prefix-fetch]
                    | --replicas http://h:p,http://h:p (attach mode)
 """
 from __future__ import annotations
@@ -127,6 +130,9 @@ def cmd_serve(args) -> int:
               kv_pool_mb=args.kv_pool_mb,
               kv_dtype=args.kv_dtype,
               paged_kernel=args.paged_kernel,
+              host_cache_mb=args.host_cache_mb,
+              disk_cache_mb=args.disk_cache_mb,
+              tier_dir=args.tier_dir,
               mask_rows=args.mask_rows,
               decode_tp=args.tp,
               speculate=args.speculate,
@@ -232,7 +238,12 @@ def cmd_serve(args) -> int:
                    f"({decoder.pool.capacity_blocks} blocks of "
                    f"{args.kv_block}"
                    + (", int8 KV" if getattr(decoder, "kv_dtype", None)
-                      else "") + ")" + kern)
+                      else "") + ")" + kern
+                   + (f", host tier {args.host_cache_mb:g}MB"
+                      + (f" + disk {args.disk_cache_mb:g}MB"
+                         if args.disk_cache_mb else "")
+                      if getattr(decoder, "tier", None) is not None
+                      else ""))
     elif pool_on:
         kv_mode = (f", prefix cache {args.prefix_cache_mb}MB "
                    f"(block {args.kv_block})")
@@ -321,6 +332,10 @@ def cmd_router(args) -> int:
         argv += ["--paged-kernel", args.paged_kernel]
     if args.no_admission:
         argv += ["--no-admission"]
+    if args.no_prefix_directory:
+        argv += ["--no-prefix-directory"]
+    if args.prefix_fetch:
+        argv += ["--prefix-fetch"]
     return router.main(argv)
 
 
@@ -417,6 +432,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="positions per KV block, paged pool and prefix "
                         "cache alike (only full blocks of a prompt are "
                         "shared)")
+    s.add_argument("--host-cache-mb", type=float, default=0.0,
+                   help="hierarchical KV tiering (paged mode only): "
+                        "evicted-but-unreferenced prefix blocks demote "
+                        "to an int8-quantized host-RAM ring of this "
+                        "byte budget (MiB) instead of vanishing, and "
+                        "promote back by zero-copy table remap on the "
+                        "next hit (0 = tiering off)")
+    s.add_argument("--disk-cache-mb", type=float, default=0.0,
+                   help="disk tier below the host ring: blocks the "
+                        "host budget evicts land in CRC-framed files "
+                        "under --tier-dir (needs --host-cache-mb)")
+    s.add_argument("--tier-dir", default=None,
+                   help="directory for disk-tier block files (default: "
+                        "a fresh tempdir)")
     s.add_argument("--kv-dtype", choices=["int8"], default=None,
                    help="quantize the PAGED KV pool's pages to int8 "
                         "(per-row max-abs scales; less than half the "
@@ -542,6 +571,13 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--no-admission", action="store_true",
                    help="disable SLO-aware admission (route even while "
                         "the fleet burns)")
+    r.add_argument("--no-prefix-directory", action="store_true",
+                   help="stop tailing replica /prefix/directory feeds "
+                        "(affinity-only routing)")
+    r.add_argument("--prefix-fetch", action="store_true",
+                   help="keep rendezvous placement and have the target "
+                        "pull tiered prefix chains from the holding "
+                        "peer instead of re-routing to it")
     r.set_defaults(func=cmd_router)
     return parser
 

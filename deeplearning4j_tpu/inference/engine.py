@@ -490,6 +490,8 @@ class DecodeScheduler:
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
                  kv_pool_mb: float = 0.0, kv_dtype: Optional[str] = None,
                  paged_kernel: str = "auto",
+                 host_cache_mb: float = 0.0, disk_cache_mb: float = 0.0,
+                 tier_dir: Optional[str] = None, tier_chunk_kib: int = 512,
                  mask_rows: int = 64,
                  mesh=None, speculate: int = 0,
                  draft_blocks: Optional[int] = None, draft_net=None,
@@ -861,6 +863,39 @@ class DecodeScheduler:
             # the occasional copy-on-write block duplication (one more)
             self._jsetpos = jax.jit(self._setpos_fn)
             self._jcow = jax.jit(self._cow_fn)
+        # -- hierarchical KV tiering (ISSUE 19, kvtier.py) ------------------
+        # opt-in (host_cache_mb=0 keeps the engine byte-identical to the
+        # tierless build: no TierManager, no extra programs, no hot-path
+        # work). When armed, pool evictions demote page rows to a host
+        # ring (then disk) and trie hits promote them back; both device
+        # programs are one fixed XLA program each (dynamic slice by a
+        # traced block index), counted in the compile budget below.
+        self.tier = None
+        self._tier_chunk = int(tier_chunk_kib) << 10
+        if host_cache_mb and host_cache_mb > 0:
+            if not self.paged:
+                warnings.warn(
+                    f"host_cache_mb={host_cache_mb} requested but paged "
+                    "KV decode is disabled — KV tiering needs the paged "
+                    "pool and stays off", RuntimeWarning, stacklevel=2)
+            else:
+                from .kvtier import TierManager
+                if disk_cache_mb and disk_cache_mb > 0 and not tier_dir:
+                    import tempfile
+                    tier_dir = tempfile.mkdtemp(prefix="kvtier-")
+                self.tier = TierManager(
+                    host_bytes=int(host_cache_mb * (1 << 20)),
+                    disk_bytes=int(disk_cache_mb * (1 << 20)),
+                    disk_dir=tier_dir,
+                    chunk_bytes=self._tier_chunk,
+                    metrics=self.metrics, tracer=self.tracer)
+                self._jtier_spill = jax.jit(self._tier_spill_fn)
+                self._jtier_restore = jax.jit(self._tier_restore_fn)
+                self.pool.tier = self.tier
+                self.tier.attach_engine(
+                    self._tier_capture,
+                    self.pool.bytes_per_block * self.pool.shard_factor,
+                    self.kv_block)
         # -- grammar-constrained decoding (ISSUE 14, logitproc.py) ---------
         # a fixed [mask_rows, vocab] ADDITIVE device table (0 allowed,
         # -inf forbidden; row 0 reserved all-zeros = admit-all). Each
@@ -1055,6 +1090,14 @@ class DecodeScheduler:
                 "prefix_cache_hit_tokens_total")
             m.ratio("prefix_cache_hit_rate", self._m_prefix_hit_tokens,
                     self._m_prefix_lookup_tokens)
+        if self.tier is not None:
+            self._m_tier_promoted = m.counter(
+                "kv_tier_promoted_blocks_total",
+                "tiered blocks adopted back into the HBM trie")
+            self._m_tier_tokens = m.counter(
+                "kv_tier_restored_tokens_total",
+                "prompt tokens served from tier promotions instead of "
+                "recompute (mid-prefill upgrades)")
         # compile-event tracing: the scheduler polls its own program
         # families' jit-cache sizes (the same CompileCounter budgets the
         # tests assert) once per iteration and stamps an instant event
@@ -1611,6 +1654,48 @@ class DecodeScheduler:
                 out[key] = st
         return out
 
+    def _tier_spill_fn(self, states, bid):
+        """Slice one page row (K/V pages + int8 scale rows) out of every
+        layer's pool arrays — the device side of a tier demotion. The
+        block index stays TRACED (dynamic slice), so the whole tier
+        ladder costs exactly one XLA program regardless of which block
+        spills; the result is an immutable functional snapshot, safe
+        against immediate reuse of the freed page."""
+        b = bid[0]
+        out = {}
+        for key, st in states.items():
+            if isinstance(st, dict) and "k_pages" in st:
+                out[key] = {
+                    pk: jax.lax.dynamic_index_in_dim(
+                        st[pk], b, axis=0, keepdims=False)
+                    for pk in PAGE_KEYS if pk in st}
+        return out
+
+    def _tier_restore_fn(self, states, bid, rows):
+        """Write one promoted page row back into the pool arrays (the
+        device side of a tier promotion) — the `_tier_spill_fn` slice in
+        reverse, again one program for every block index."""
+        b = bid[0]
+        out = {}
+        for key, st in states.items():
+            if isinstance(st, dict) and "k_pages" in st and key in rows:
+                st2 = dict(st)
+                for pk, row in rows[key].items():
+                    st2[pk] = jax.lax.dynamic_update_index_in_dim(
+                        st[pk], row.astype(st[pk].dtype), b, axis=0)
+                out[key] = st2
+            else:
+                out[key] = st
+        return out
+
+    def _tier_capture(self, bid: int):
+        """TierManager capture hook (scheduler thread, from the pool's
+        `_evict_lru`): dispatch the spill slice and hand the device
+        snapshot to the tier worker — the actual device->host read
+        happens on the worker thread under the pacing budget, never
+        here."""
+        return self._jtier_spill(self._states, self._dev_index(bid))
+
     def _reset_slot_state(self, slot: int) -> None:
         # _states is single-writer by protocol: only the scheduler thread
         # mutates it once start() returns. warmup() — the one cross-thread
@@ -1891,6 +1976,17 @@ class DecodeScheduler:
         seq.pool_node = node  # holds one reference until the slot frees
         if node is not None:
             ledger_note("trie_pin", seq.handle.request_id, +1)
+        if self.tier is not None:
+            # tier directory lookup past the resident frontier: queue
+            # host/disk blocks for background promotion. The slot does
+            # NOT wait — it prefills its cold suffix as usual, and a
+            # landed promotion upgrades it mid-prefill (_tier_tick)
+            frontier = node.hash if node is not None else ""
+            if frontier is not None:
+                ext = self.tier.lookup_extension(
+                    frontier, seq.prompt, n_blk, max_hit)
+                if ext:
+                    self.tier.request_restore(ext)
         if not n_blk:
             return
         seq.block_ids = [int(b) for b in ids]
@@ -2210,6 +2306,10 @@ class DecodeScheduler:
                     # is garbage-collected wholesale
                     ledger_forget(seq.handle.request_id, _LEDGER_KINDS)
             self._slots = [None] * self.n_slots  # graftlint: disable=CC004
+            if self.tier is not None:
+                # disowned engine: stop the worker, skip the balance
+                # check (the ledger entries were forgotten wholesale)
+                self.tier.stop(check=False)
             return
         with self._cond:
             self._running = False
@@ -2246,6 +2346,11 @@ class DecodeScheduler:
                 self._trace_done("cancel", seq, slot=i)
                 self._slots[i] = None
                 ledger_note("engine_slot", seq.handle.request_id, -1)
+        if self.tier is not None:
+            # joins the transfer worker and zeroes the tier ledger
+            # (host_page / disk_block / directory_entry) before the
+            # engine's own balance check below
+            self.tier.stop()
         ledger_check_zero("engine.stop", _LEDGER_KINDS)
 
     # -- scheduler loop ----------------------------------------------------
@@ -2936,6 +3041,143 @@ class DecodeScheduler:
         if accepted:
             self._m_spec_accepted.inc(accepted)
 
+    # -- KV tiering (kvtier.py, ISSUE 19) ----------------------------------
+    def _tier_tick(self) -> None:
+        """Per-iteration tier maintenance on the scheduler thread: grant
+        the worker its pacing credits, serve pending HBM copydowns
+        (peer fetches), integrate promotions the worker staged, and
+        upgrade mid-prefill slots onto newly resident blocks. Every
+        step is bounded — the decode hot path never waits on a
+        transfer; an un-landed promotion just means the slot keeps
+        prefilling its cold suffix as today."""
+        tier = self.tier
+        idle = all(s is None for s in self._slots)
+        # idle iterations run at the 10 Hz wake; grant a bigger budget
+        # so a backlog drains fast when nobody is decoding
+        grant = self._tier_chunk * (8 if idle else 1)
+        tier.pace(grant)
+        for h in tier.pending_copydowns(4):
+            self._tier_copydown(h)
+        promoted = False
+        for entry, rows in tier.drain_ready(grant):
+            promoted = self._integrate_promotion(entry, rows) or promoted
+        if promoted:
+            self._try_upgrade_slots()
+
+    def _tier_copydown(self, h: str) -> None:
+        """Capture an HBM-resident chain block into the host ring (no
+        eviction) so /prefix/block can serve it to a peer."""
+        tier = self.tier
+        info = tier.entry_info(h)
+        if info is None:
+            return
+        prefix, depth = info
+        node, ids = self.pool._walk_prefix(list(prefix), depth)
+        if len(ids) != depth or node.hash != h:
+            return  # no longer resident; waiter times out / uses a tier
+        tier.complete_copydown(h, self._tier_capture(node.block_id))
+
+    def _integrate_promotion(self, entry, rows) -> bool:
+        """Upload one promoted page row and adopt it into the trie via
+        the zero-copy publish path. Any failure — injected fault, no
+        free page, parent chain gone — drops the promotion; the prefix
+        recomputes cold (correct, just slower)."""
+        tier = self.tier
+        tokens = list(entry.prefix)
+        depth = int(entry.depth)
+        node, ids = self.pool._walk_prefix(tokens, depth)
+        if len(ids) == depth:
+            tier.promotion_done(entry.hash, True)  # already resident
+            return False
+        if len(ids) != depth - 1:
+            tier.promotion_done(entry.hash, False)  # parents not landed
+            return False
+        bid = self.pool.alloc()
+        if bid is None:
+            # pool fully referenced: promotion must never preempt live
+            # work — drop it, the hot path wins
+            tier.promotion_done(entry.hash, False)
+            return False
+        try:
+            dev_rows = {
+                lk: {pk: self._dev_array(a) for pk, a in pks.items()}
+                for lk, pks in rows.items()}
+            self._states = self._jtier_restore(  # graftlint: disable=CC005
+                self._states, self._dev_index(bid), dev_rows)
+        except Exception:
+            self.pool.free_block(bid)
+            tier.promotion_done(entry.hash, False)
+            raise
+        # zero-copy adopt: the trie takes over the freshly-written page
+        # (note_resident fires inside, flipping the directory tier)
+        self.pool.adopt(tokens, ids + [bid])
+        tier.promotion_done(entry.hash, True)
+        self._m_tier_promoted.inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "tier_restore", track="scheduler",
+                args={"hash": entry.hash[:12], "depth": depth,
+                      "block": bid})
+        return True
+
+    def _try_upgrade_slots(self) -> None:
+        """Re-match mid-prefill slots against the trie after promotions
+        landed: a slot whose cold suffix just became resident swaps its
+        pin to the deeper node, remaps its table onto the shared
+        blocks, and jumps ``pos`` past them — the restore-in-flight
+        contract: prefill as usual until the pages land, then skip."""
+        B = self.kv_block
+        for i, seq in enumerate(self._slots):
+            if seq is None or seq.fed >= len(seq.prompt) \
+                    or seq.cow_starved:
+                continue
+            max_hit = len(seq.prompt) // B
+            cur = seq.fed // B
+            if max_hit <= cur:
+                continue
+            n2, ids2, node2 = self.pool.match(seq.prompt, max_hit)
+            if node2 is None:
+                continue
+            if n2 * B <= seq.fed:
+                self.pool.release(node2)
+                continue
+            rid = seq.handle.request_id
+            if seq.pool_node is not None:
+                self.pool.release(seq.pool_node)
+                seq.pool_node = None
+            else:
+                ledger_note("trie_pin", rid, +1)
+            seq.pool_node = node2
+            freed = 0
+            for j in range(cur, n2):
+                bid2 = ids2[j]  # host ints from the trie walk
+                if j < len(seq.block_ids):
+                    if not seq.shared[j] \
+                            and seq.block_ids[j] != bid2:
+                        self.pool.free_block(seq.block_ids[j])
+                        freed += 1
+                    seq.block_ids[j] = bid2
+                    seq.shared[j] = True
+                else:
+                    seq.block_ids.append(bid2)
+                    seq.shared.append(True)
+                self._table[i, j] = ids2[j]  # graftlint: disable=CC005
+            if freed:
+                ledger_note("pool_block", rid, -freed)
+            fed = min(n2 * B, len(seq.prompt) - 1)
+            gained = fed - seq.fed
+            self._states = self._jsetpos(  # graftlint: disable=CC005
+                self._states, self._dev_index(i), self._dev_index(fed))
+            seq.fed = fed
+            seq.written = fed
+            self._m_tier_tokens.inc(gained)
+            self._m_prefix_hits.inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "tier_restore", track=self._slot_tracks[i],
+                    args={"request": rid, "tokens": gained,
+                          "blocks": n2 - cur})
+
     def _step_once(self) -> bool:
         """One scheduler iteration (admission + at most one prefill chunk
         + the all-slots decode step). Returns False when it idled.
@@ -2951,6 +3193,13 @@ class DecodeScheduler:
         prof = self.profiler
         prof.iter_begin()
         self._evict_cancelled()
+        if self.tier is not None:
+            # pace the tier worker and integrate landed promotions
+            # BEFORE admission, so an arriving prompt can match blocks
+            # promoted this very iteration. Runs on idle passes too
+            # (the 10 Hz idle wake in _loop) so spills/promotions drain
+            # while the engine has nothing else to do.
+            self._tier_tick()
         self._admit()
         # single-writer: _slots is mutated only by this scheduler thread
         # once start() returns (submit() touches only _queue, under
@@ -3301,6 +3550,17 @@ class DecodeScheduler:
             self._jsetpos(self._states, slot0, self._dev_index(0))
             self._jcow(self._states, self._dev_index(SCRATCH_BLOCK),
                        self._dev_index(SCRATCH_BLOCK))
+            if self.tier is not None:
+                # tier spill/restore: warm with the scratch row, fed
+                # back through np.asarray + _dev_array — the EXACT
+                # structure/dtypes/placement the live path uses (worker
+                # device-get, scheduler upload), so one program each
+                scratch = self._dev_index(SCRATCH_BLOCK)
+                dev = self._jtier_spill(self._states, scratch)
+                rows = {lk: {pk: self._dev_array(np.asarray(a))
+                             for pk, a in pks.items()}
+                        for lk, pks in dev.items()}
+                self._jtier_restore(self._states, scratch, rows)
         else:
             self._jstep(params, variables, ids, live, self._states)
             for b in self.prefill_buckets:
@@ -3561,6 +3821,8 @@ class DecodeScheduler:
                 # trie mutated mid-walk (dict changed size): a refresh
                 # one poll later sees a settled view
                 out["pool"] = {"error": "pool busy, retry"}
+        if self.tier is not None:
+            out["tier"] = self.tier.stats()
         if self.speculate:
             out["speculative"] = {
                 "gamma": self.speculate,

@@ -75,7 +75,8 @@ __all__ = ["InjectedFault", "InjectedCrash", "InjectedOOM", "InjectedHang",
 # spec error — a typo'd seam name must not silently never fire)
 SEAMS = ("scheduler.iteration", "dispatch.decode", "dispatch.prefill",
          "dispatch.verify", "pool.alloc", "batcher.flush", "http.handler",
-         "router.journal", "router.dispatch")
+         "router.journal", "router.dispatch", "tier.spill", "tier.restore",
+         "directory.publish")
 
 
 class InjectedFault(RuntimeError):

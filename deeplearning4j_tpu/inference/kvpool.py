@@ -102,7 +102,7 @@ class _Node:
     allocating) — locked nodes and interior nodes are never evicted."""
 
     __slots__ = ("key", "block_id", "parent", "children", "last_access",
-                 "lock")
+                 "lock", "hash")
 
     def __init__(self, key: Tuple[int, ...], block_id: int,
                  parent: Optional["_Node"]):
@@ -112,6 +112,10 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.last_access = 0
         self.lock = 0
+        #: content-addressed chain hash (kvtier.chain_hash over the
+        #: ancestor chain); computed only when a TierManager is armed —
+        #: None otherwise, and "" at the root
+        self.hash: Optional[str] = None
 
 
 class KVPool:
@@ -194,6 +198,12 @@ class KVPool:
                 for key, (row_shape, dtype) in shapes.items()}
         self._free: List[int] = list(range(1, self.capacity_blocks + 1))
         self._root = _Node((), SCRATCH_BLOCK, None)
+        self._root.hash = ""
+        #: optional kvtier.TierManager — armed by the engine before any
+        #: traffic. When set, every trie node is chain-hashed, inserts
+        #: publish to the prefix directory, and LRU evictions offer the
+        #: victim's pages for demotion instead of silently freeing them.
+        self.tier = None
         self._clock = 0  # logical LRU clock (monotonic per pool op)
         self._metrics = metrics
         self._g_live = self._g_free = self._g_dev_used = None
@@ -228,6 +238,20 @@ class KVPool:
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
+
+    def _hash_and_publish(self, node: _Node) -> None:
+        """Chain-hash a freshly attached node and publish it to the
+        prefix directory — only when a TierManager is armed (the
+        tierless pool pays nothing, not even the sha1)."""
+        tier = self.tier
+        if tier is None:
+            return
+        parent_hash = node.parent.hash
+        if parent_hash is None:
+            return  # ancestor predates arming; leave the branch unhashed
+        from .kvtier import chain_hash
+        node.hash = chain_hash(parent_hash, node.key)
+        tier.note_resident(node.hash, parent_hash, node.key)
 
     def _sync_gauges(self) -> None:
         if self._g_live is not None:
@@ -370,6 +394,7 @@ class KVPool:
             key = tuple(int(t) for t in tokens[j * B:(j + 1) * B])
             child = _Node(key, int(block_ids[j]), node)
             node.children[key] = child
+            self._hash_and_publish(child)
             node = child
             node.last_access = self._tick()
             adopted.append(int(block_ids[j]))
@@ -425,6 +450,7 @@ class KVPool:
                 key = tuple(int(t) for t in tokens[j * B:(j + 1) * B])
                 child = _Node(key, bid, node)
                 node.children[key] = child
+                self._hash_and_publish(child)
                 node = child
                 node.last_access = self._tick()
                 node.lock += 1  # keep the fresh chain out of eviction
@@ -463,6 +489,12 @@ class KVPool:
             _, _, victim = heapq.heappop(heap)
             parent = victim.parent
             del parent.children[victim.key]
+            if self.tier is not None:
+                # demotion interception: capture the page row BEFORE the
+                # id returns to the free list (the captured device
+                # snapshot is immutable under functional updates, so the
+                # reused id can be rewritten immediately)
+                self.tier.offer_spill(victim.hash, victim.block_id)
             self._free.append(victim.block_id)
             freed += 1
             if parent is not self._root and not parent.children \

@@ -428,3 +428,46 @@ class DurableStreamingTrainer:
             processed += len(records)
             if self.on_batch is not None:
                 self.on_batch(records)
+
+
+# -- single-block files (KV tier disk store) ---------------------------------
+# The KV tiering subsystem (inference/kvtier.py) persists one evicted
+# prefix block per file using the SAME frame discipline as the log: a
+# process SIGKILLed mid-spill leaves either no file (tmp never renamed)
+# or a complete CRC-verified frame — a torn or corrupt file reads as a
+# cache MISS, never as wrong bytes fed back into attention.
+
+def write_block_file(path: str, payload: bytes) -> None:
+    """Atomically persist one opaque payload as a CRC-framed file
+    (tmp + rename + fsync — the statetracker/cursor discipline)."""
+    if len(payload) > MAX_FRAME:
+        raise ValueError(f"block payload {len(payload)} exceeds "
+                         f"MAX_FRAME {MAX_FRAME}")
+    hdr = _HDR.pack(_MAGIC, len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(hdr)
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_block_file(path: str) -> Optional[bytes]:
+    """Read one CRC-framed block file. Returns None — a miss — on any
+    defect: missing file, short header, wrong magic, truncated payload,
+    or CRC mismatch (the SIGKILL-mid-spill leftovers)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    if len(raw) < _HDR.size:
+        return None
+    magic, length, crc = _HDR.unpack_from(raw, 0)
+    if magic != _MAGIC or length > MAX_FRAME:
+        return None
+    payload = raw[_HDR.size:_HDR.size + length]
+    if len(payload) != length or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        return None
+    return payload

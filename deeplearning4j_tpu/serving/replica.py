@@ -527,6 +527,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--kv-dtype", default=None)
     ap.add_argument("--paged-kernel", choices=["auto", "on", "off"],
                     default="auto")
+    ap.add_argument("--host-cache-mb", type=float, default=0.0,
+                    help="pinned host-RAM KV spill ring (ISSUE 19 "
+                         "tiering; 0 disables)")
+    ap.add_argument("--disk-cache-mb", type=float, default=0.0,
+                    help="durable disk tier below the host ring")
+    ap.add_argument("--tier-dir", default=None,
+                    help="directory for disk-tier block files "
+                         "(default: fresh tempdir)")
     ap.add_argument("--tp", type=int, default=0)
     ap.add_argument("--slo-p99-ms", type=float, default=None)
     ap.add_argument("--hang-timeout", type=float, default=5.0)
@@ -558,6 +566,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         kv_block=args.kv_block, kv_pool_mb=args.kv_pool_mb,
         prefix_cache_mb=args.prefix_cache_mb, kv_dtype=args.kv_dtype,
         paged_kernel=args.paged_kernel,
+        host_cache_mb=args.host_cache_mb,
+        disk_cache_mb=args.disk_cache_mb, tier_dir=args.tier_dir,
         decode_tp=args.tp, slo_p99_ms=args.slo_p99_ms,
         hang_timeout_s=args.hang_timeout, retry_budget=args.retry_budget,
         trace_buffer=args.trace_buffer,

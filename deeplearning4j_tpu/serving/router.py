@@ -398,7 +398,10 @@ class FleetRouter:
                  startup_wait_s: float = 300.0,
                  metrics: Optional[MetricsRegistry] = None,
                  tracer: Optional[FlightRecorder] = None,
-                 trace_buffer: int = 8192):
+                 trace_buffer: int = 8192,
+                 prefix_directory: bool = True,
+                 prefix_fetch: bool = False,
+                 directory_max_blocks: int = 64):
         if supervisor is None:
             if not replica_urls:
                 raise ValueError("pass a ReplicaSupervisor or replica_urls")
@@ -483,6 +486,26 @@ class FleetRouter:
             "router_stream_requests_total",
             help="/generate stream=true requests proxied as SSE "
                  "pass-through")
+        # fleet prefix directory (ISSUE 19): block-hash chains -> the
+        # replicas holding them (any tier), fed by tailing each
+        # replica's /prefix/directory on the scrape cadence
+        self.prefix_directory = bool(prefix_directory)
+        self.prefix_fetch = bool(prefix_fetch)
+        self.directory_max_blocks = int(directory_max_blocks)
+        self._dir_entries: Dict[str, Dict[str, str]] = {}  # hash -> {name: tier}
+        self._dir_state: Dict[str, dict] = {}  # name -> {epoch, next, skip_until}
+        self._g_dir_entries = m.gauge(
+            "router_directory_entries",
+            help="distinct block hashes the router can route to "
+                 "(union over replicas and tiers)")
+        self._m_dir_hits = m.counter(
+            "router_directory_hits_total",
+            help="dispatches routed to a replica BECAUSE the prefix "
+                 "directory says it holds the deepest prompt chain")
+        self._m_prefix_fetches = m.counter(
+            "router_prefix_fetches_total",
+            help="peer-pull instructions (/prefix/fetch) issued to the "
+                 "affinity target before admission")
         self._m_stream_disconnects = m.counter(
             "router_stream_disconnects_total",
             help="SSE clients that hung up mid-stream at the router "
@@ -526,8 +549,148 @@ class FleetRouter:
                        "replicas_up": fed["replicas_up"]}
         with self._lock:
             self._admission = verdict
+        if self.prefix_directory:
+            self._poll_directory(ready)  # network OUTSIDE the lock
         if self.journal is not None:
             self.journal.advance()
+
+    # -- fleet prefix directory (ISSUE 19) ---------------------------------
+    def _poll_directory(self, ready) -> None:
+        """Tail every ready replica's ``/prefix/directory`` feed. A 404
+        means that replica runs without tiering — back off polling it
+        for a while instead of knocking every scrape pass."""
+        now = time.monotonic()
+        for name, url in ready:
+            with self._lock:
+                st = self._dir_state.setdefault(
+                    name, {"epoch": None, "next": 0, "skip_until": 0.0})
+                if now < st["skip_until"]:
+                    continue
+                since = st["next"] if st["epoch"] is not None else 0
+            try:
+                with urllib.request.urlopen(
+                        f"{url}/prefix/directory?since={since}",
+                        timeout=2.0) as resp:
+                    feed = json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                e.close()
+                if e.code == 404:
+                    with self._lock:
+                        st["skip_until"] = now + 10.0
+                continue
+            except (urllib.error.URLError, OSError, ValueError):
+                continue  # flaky scrape: next pass retries
+            self._directory_ingest(name, feed)
+
+    def _directory_ingest(self, name: str, feed: dict) -> None:
+        with self._lock:
+            st = self._dir_state.setdefault(
+                name, {"epoch": None, "next": 0, "skip_until": 0.0})
+            st["skip_until"] = 0.0
+            if feed.get("reset") or feed.get("epoch") != st["epoch"]:
+                # replica restarted (new epoch) or our cursor fell off
+                # its ring: drop everything it published and resync
+                # from the snapshot
+                for h in [h for h, holders in self._dir_entries.items()
+                          if name in holders]:
+                    holders = self._dir_entries[h]
+                    holders.pop(name, None)
+                    if not holders:
+                        del self._dir_entries[h]
+                st["epoch"] = feed.get("epoch")
+            for ev in feed.get("events") or []:
+                h = ev.get("hash")
+                if not h:
+                    continue
+                if ev.get("op") == "put":
+                    self._dir_entries.setdefault(h, {})[name] = \
+                        ev.get("tier", "host")
+                else:
+                    holders = self._dir_entries.get(h)
+                    if holders is not None:
+                        holders.pop(name, None)
+                        if not holders:
+                            del self._dir_entries[h]
+            nxt = feed.get("next", 0)  # parsed-JSON host scalar
+            st["next"] = int(nxt)
+            self._g_dir_entries.set(len(self._dir_entries))
+
+    def _directory_chain(self, prompt: Sequence[int]) -> List[str]:
+        if not prompt:
+            return []
+        from ..inference.kvtier import prompt_chain
+        return prompt_chain(prompt, self.kv_block,
+                            self.directory_max_blocks)
+
+    def _directory_pick(self, prompt: Sequence[int],
+                        tried: set) -> Optional[Tuple[str, str, int,
+                                                      List[str]]]:
+        """(name, url, depth_blocks, chain_hashes) for the untried
+        ready replica holding the DEEPEST block-hash chain of this
+        prompt in any tier, or None when the directory has nothing.
+        Ties at a depth prefer warmer tiers (hbm > host > disk)."""
+        chain = self._directory_chain(prompt)
+        if not chain:
+            return None
+        ready = dict(self.supervisor.ready_replicas())
+        rank = {"hbm": 0, "spilling": 0, "host": 1, "disk": 2}
+        with self._lock:
+            for i in range(len(chain) - 1, -1, -1):
+                holders = self._dir_entries.get(chain[i])
+                if not holders:
+                    continue
+                best = None
+                for nm, tier in holders.items():
+                    if nm in tried or nm not in ready:
+                        continue
+                    r = rank.get(tier, 3)
+                    if best is None or r < best[0]:
+                        best = (r, nm)
+                if best is not None:
+                    nm = best[1]
+                    return nm, ready[nm], i + 1, chain[:i + 1]
+        return None
+
+    def _prefix_warm(self, target_url: str, holder_url: str,
+                     hashes: List[str]) -> None:
+        """Instruct the affinity target to pull the chain from the
+        holder before the request lands (prefix-fetch mode). Best
+        effort: a failed warm just means a cold prefill."""
+        body = json.dumps({"peer": holder_url,
+                           "hashes": hashes}).encode()
+        try:
+            req = urllib.request.Request(
+                target_url + "/prefix/fetch", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                resp.read()
+            self._m_prefix_fetches.inc()
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+
+    def _pick_with_directory(self, attempt: int, key: bytes,
+                             prompt: Sequence[int], tried: set,
+                             deadline: float) -> Optional[Tuple[str, str]]:
+        """Candidate selection with prefix-directory awareness: on the
+        FIRST attempt, a directory hit either routes straight to the
+        holder (default) or keeps the rendezvous choice and warms it
+        from the holder (``prefix_fetch``). Failover attempts fall back
+        to plain rendezvous ranking — correctness never depends on the
+        directory being fresh."""
+        if attempt == 0 and self.prefix_directory:
+            hint = self._directory_pick(prompt, tried)
+            if hint is not None:
+                name, url, _depth, hashes = hint
+                if not self.prefix_fetch:
+                    self._m_dir_hits.inc()
+                    return name, url
+                cand = self._next_candidate(key, tried, deadline)
+                if cand is None or cand[0] == name:
+                    self._m_dir_hits.inc()
+                    return (name, url) if cand is None else cand
+                self._prefix_warm(cand[1], url, hashes)
+                return cand
+        return self._next_candidate(key, tried, deadline)
 
     def _scrape_loop(self) -> None:
         while not self._stop_scrape.wait(self.scrape_interval_s):
@@ -612,7 +775,9 @@ class FleetRouter:
         tried: set = set()
         last_err: Optional[BaseException] = None
         for attempt in range(self.dispatch_attempts):
-            cand = self._next_candidate(key, tried, deadline)
+            cand = self._pick_with_directory(
+                attempt, key, payload.get("prompt") or [], tried,
+                deadline)
             if cand is None:
                 break
             name, url = cand
@@ -1103,7 +1268,9 @@ class FleetRouter:
         tried: set = set()
         last_err: Optional[BaseException] = None
         for attempt in range(self.dispatch_attempts):
-            cand = self._next_candidate(key, tried, deadline)
+            cand = self._pick_with_directory(
+                attempt, key, payload.get("prompt") or [], tried,
+                deadline)
             if cand is None:
                 break
             name, url = cand
@@ -1526,6 +1693,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--no-admission", action="store_true",
                     help="disable SLO-aware admission (route even while "
                          "the fleet burns)")
+    ap.add_argument("--no-prefix-directory", action="store_true",
+                    help="disable the fleet prefix directory (route by "
+                         "rendezvous affinity only)")
+    ap.add_argument("--prefix-fetch", action="store_true",
+                    help="directory hits keep the rendezvous target and "
+                         "instruct it to PULL the chain from the holder "
+                         "(instead of routing to the holder)")
     args = ap.parse_args(argv)
     if bool(args.replicas) == bool(args.spawn):
         ap.error("pass exactly one of --replicas or --spawn")
@@ -1547,7 +1721,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         kv_block=args.kv_block, affinity_blocks=args.affinity_blocks,
         quorum=args.quorum, scrape_interval_s=args.scrape_interval,
         dispatch_attempts=args.dispatch_attempts,
-        admission_burn=not args.no_admission).start()
+        admission_burn=not args.no_admission,
+        prefix_directory=not args.no_prefix_directory,
+        prefix_fetch=args.prefix_fetch).start()
 
     stop = threading.Event()
 

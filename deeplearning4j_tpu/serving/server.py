@@ -218,6 +218,8 @@ class InferenceServer:
                  prefix_cache_mb: float = 0.0, kv_block: int = 16,
                  kv_pool_mb: float = 0.0, kv_dtype: Optional[str] = None,
                  paged_kernel: str = "auto",
+                 host_cache_mb: float = 0.0, disk_cache_mb: float = 0.0,
+                 tier_dir: Optional[str] = None,
                  mask_rows: int = 64,
                  decode_tp: int = 0, speculate: int = 0,
                  draft_blocks: int = 0, draft_net=None,
@@ -252,6 +254,12 @@ class InferenceServer:
         self.kv_block = int(kv_block)
         self.kv_pool_mb = float(kv_pool_mb)
         self.kv_dtype = kv_dtype
+        # hierarchical KV tiering (ISSUE 19, inference/kvtier.py):
+        # host-RAM + disk demotion targets for pool evictions, plus the
+        # fleet prefix-directory endpoints below
+        self.host_cache_mb = float(host_cache_mb)
+        self.disk_cache_mb = float(disk_cache_mb)
+        self.tier_dir = tier_dir
         # fused Pallas decode kernel (ISSUE 15): the factory passes the
         # mode through on every (re)build, so crash recovery and
         # draining restarts come back with the same kernel decision —
@@ -349,6 +357,55 @@ class InferenceServer:
             return self.supervisor.engine
         return self._decoder_direct
 
+    def _tier(self):
+        """The live engine's TierManager, or None when tiering is off
+        (``host_cache_mb == 0``) or no decode engine is configured."""
+        dec = self._decoder
+        return getattr(dec, "tier", None) if dec is not None else None
+
+    def _prefix_fetch(self, payload: dict) -> Tuple[int, dict]:
+        """POST /prefix/fetch body: pull a block-hash chain from a peer
+        replica's ``/prefix/block`` endpoint into the local tier.
+
+        Hashes MUST arrive parent-first (the router sends them in chain
+        order): ``insert_fetched`` rejects a child whose parent chain is
+        unknown, so a failed parent makes the rest of the chain
+        unreachable and we stop rather than burn peer round-trips."""
+        tier = self._tier()
+        if tier is None:
+            return 404, {"error": "KV tiering disabled"}
+        peer = payload.get("peer") or ""
+        hashes = payload.get("hashes") or []
+        if not peer or not isinstance(hashes, list):
+            return 400, {"error": "need peer URL and hashes list"}
+        import urllib.request
+        fetched, skipped, failed = 0, 0, 0
+        inserted = []
+        for h in hashes:
+            h = str(h)
+            if tier.holds(h):
+                skipped += 1
+                continue
+            try:
+                with urllib.request.urlopen(
+                        peer.rstrip("/") + "/prefix/block?hash=" + h,
+                        timeout=10.0) as resp:
+                    body = resp.read()
+            except OSError:
+                failed += 1
+                break
+            if tier.insert_fetched(body) is None:
+                failed += 1
+                break
+            fetched += 1
+            inserted.append(h)
+        if inserted:
+            # warm the pulled chain immediately: the request that
+            # triggered this fetch is usually right behind it
+            tier.request_restore(inserted)
+        return 200, {"fetched": fetched, "skipped": skipped,
+                     "failed": failed}
+
     def _decoder_factory(self) -> DecodeScheduler:
         return DecodeScheduler(
             self.net, self.decode_vocab, n_slots=self.decode_slots,
@@ -359,6 +416,9 @@ class InferenceServer:
             kv_pool_mb=self.kv_pool_mb,
             kv_dtype=self.kv_dtype,
             paged_kernel=self.paged_kernel,
+            host_cache_mb=self.host_cache_mb,
+            disk_cache_mb=self.disk_cache_mb,
+            tier_dir=self.tier_dir,
             mask_rows=self.mask_rows,
             mesh=self.decode_tp if self.decode_tp > 1 else None,
             speculate=self.speculate,
@@ -825,6 +885,41 @@ class InferenceServer:
                         # pass the previous response's next_cursor
                         self._send(server.tracer.snapshot(limit=limit,
                                                           since=since))
+                elif url.path == "/prefix/directory":
+                    # fleet prefix directory feed (ISSUE 19): the router
+                    # tails this incrementally with ?since=<next cursor>;
+                    # a cursor gap or since<=0 returns a reset snapshot
+                    tier = server._tier()
+                    if tier is None:
+                        return self._send(
+                            {"error": "KV tiering disabled "
+                                      "(start with --host-cache-mb)"}, 404)
+                    q = parse_qs(url.query)
+                    try:
+                        since = int(q.get("since", ["0"])[0])
+                    except ValueError:
+                        return self._send(
+                            {"error": "since must be an integer"}, 400)
+                    self._send(tier.directory_feed(since))
+                elif url.path == "/prefix/block":
+                    # peer block pull: serve one spilled KV block as the
+                    # raw encode_block() payload (CRC-framed JSON) so a
+                    # peer replica can adopt the prefix without
+                    # recomputing it
+                    tier = server._tier()
+                    if tier is None:
+                        return self._send(
+                            {"error": "KV tiering disabled"}, 404)
+                    q = parse_qs(url.query)
+                    h = q.get("hash", [""])[0]
+                    payload = (tier.get_block_payload(h, timeout=5.0)
+                               if h else None)
+                    if payload is None:
+                        return self._send(
+                            {"error": "block not available", "hash": h},
+                            404)
+                    self._send(payload,
+                               content_type="application/octet-stream")
                 else:
                     self._send({"error": "not found"}, 404)
 
@@ -958,6 +1053,14 @@ class InferenceServer:
                             self._send(server._generate(
                                 payload, timeout_ms,
                                 request_id=rid), request_id=rid)
+                    elif url.path == "/prefix/fetch":
+                        # router-directed peer pull (ISSUE 19): fetch a
+                        # prefix block chain from the replica that holds
+                        # it, adopt into the local tier, queue promotion
+                        payload = json.loads(raw.decode())
+                        code, body = server._prefix_fetch(payload)
+                        body["request_id"] = rid
+                        self._send(body, code, request_id=rid)
                     else:
                         self._send({"error": "not found"}, 404,
                                    request_id=rid)

@@ -283,6 +283,7 @@ _SERVING_PAGE = """<!DOCTYPE html>
 <div id="decode" style="color:#555"></div>
 <div id="mesh" style="color:#555"></div>
 <div id="kvpool" style="color:#555"></div>
+<div id="kvtier" style="color:#555"></div>
 <div id="robust" style="color:#555"></div>
 <div id="slo" style="color:#555"></div>
 <div id="fleet" style="color:#555"></div>
@@ -373,6 +374,28 @@ async function refresh() {
         g.kv_pool_blocks_live.max : 0) + ')' +
       (c.decode_preempted_total ? ', ' + c.decode_preempted_total +
         ' preempted' : '');
+  // hierarchical KV tiering line (inference/kvtier.py): host/disk
+  // occupancy, per-tier hit rates over directory lookups, spill and
+  // promote traffic — "is the spill ladder earning its budget"
+  if (g.kv_tier_host_bytes !== undefined)
+    document.getElementById('kvtier').innerText =
+      'kv tiers: host ' + (g.kv_tier_host_blocks ?
+        g.kv_tier_host_blocks.value : 0) + ' blocks (' +
+      ((g.kv_tier_host_bytes.value || 0) / 1048576).toFixed(2) + 'MB)' +
+      (g.kv_tier_disk_blocks && g.kv_tier_disk_blocks.value ?
+        ', disk ' + g.kv_tier_disk_blocks.value + ' blocks (' +
+        ((g.kv_tier_disk_bytes || {}).value / 1048576 || 0).toFixed(2) +
+        'MB)' : '') +
+      ', directory ' + ((g.kv_tier_directory_entries || {}).value || 0) +
+      ' entries, hit host ' +
+      (100 * (r.kv_tier_host_hit_rate || 0)).toFixed(1) + '%' +
+      (r.kv_tier_disk_hit_rate ? ' / disk ' +
+        (100 * r.kv_tier_disk_hit_rate).toFixed(1) + '%' : '') +
+      ' of ' + (c.kv_tier_lookups_total || 0) + ' lookups, ' +
+      (c.kv_tier_spilled_blocks_total || 0) + ' spilled / ' +
+      (c.kv_tier_promoted_blocks_total || 0) + ' promoted' +
+      (c.kv_tier_restore_failed_total ? ', ' +
+        c.kv_tier_restore_failed_total + ' restore failure(s)' : '');
   // fault-tolerance line (inference/supervisor.py): readiness, engine
   // restarts, recovered/abandoned requests, degradation rung, chaos
   // triggers — the at-a-glance "is the supervisor earning its keep"

@@ -295,8 +295,35 @@ def _make_lm(vocab=32, cache=96):
     return ComputationGraph(conf).init()
 
 
+
+def zipf_prompts(n, vocab, prompt_len, k_users, s=1.1, prefix_len=None,
+                 seed=0):
+    """Deterministic zipf-distributed prompt mix (ISSUE 19): ``k_users``
+    "users" each own a fixed shared prefix; every request is its user's
+    prefix plus a fresh random suffix, with users drawn rank-weighted
+    ~ 1/rank**s. Hot users repeat their prefix constantly, cold users
+    barely ever — the canonical serving distribution for prefix-cache
+    and KV-tiering experiments (same generator bench.py kv_tiering
+    uses, so load-test numbers and bench numbers describe one mix)."""
+    rng = np.random.default_rng(seed)
+    if prefix_len is None:
+        prefix_len = (prompt_len * 2) // 3
+    prefix_len = max(1, min(int(prefix_len), prompt_len - 1))
+    prefixes = [rng.integers(0, vocab, prefix_len).tolist()
+                for _ in range(max(1, int(k_users)))]
+    w = 1.0 / np.power(np.arange(1, len(prefixes) + 1, dtype=np.float64),
+                       float(s))
+    w /= w.sum()
+    users = rng.choice(len(prefixes), size=int(n), p=w)
+    return [prefixes[u]
+            + rng.integers(0, vocab, prompt_len - prefix_len).tolist()
+            for u in users]
+
+
 def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
-                  trace_out=None, mesh=0, stream=False, verbose=True):
+                  trace_out=None, mesh=0, stream=False, verbose=True,
+                  zipf=0, zipf_s=1.1, prefix_len=None,
+                  host_cache_mb=0.0):
     """Drive POST /generate and show where each request's time went.
     ``mesh`` > 1: tensor-parallel decode over that many devices, paged
     KV pool (per-device budget) instead of the contiguous prefix
@@ -318,6 +345,12 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
     net = _make_lm(vocab, cache=prompt_len + new_tokens)
     kw = (dict(kv_pool_mb=4.0, decode_tp=mesh) if mesh and mesh > 1
           else dict(prefix_cache_mb=16))
+    if host_cache_mb and host_cache_mb > 0:
+        # KV tiering needs the paged pool; a deliberately tight HBM
+        # budget makes the host ring actually absorb evictions
+        kw = dict(kv_pool_mb=kw.get("kv_pool_mb", 1.0),
+                  decode_tp=mesh if mesh and mesh > 1 else 0,
+                  host_cache_mb=host_cache_mb)
     srv = InferenceServer(net=net, decode_vocab=vocab, decode_slots=4,
                           prefill_chunk=16, kv_block=8, **kw).start()
     rng = np.random.default_rng(0)
@@ -325,11 +358,16 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
     ctracer = ClientTracer(FlightRecorder(8192))
     # prompts pre-built on the main thread (numpy Generators are not
     # thread-safe); a few repeats so the prefix cache has something to hit
+    n_prompts = max(1, n_threads * reqs_each // 2)
+    prompts = (zipf_prompts(n_prompts, vocab, prompt_len, zipf, s=zipf_s,
+                            prefix_len=prefix_len, seed=0)
+               if zipf else
+               [rng.integers(0, vocab, prompt_len).tolist()
+                for _ in range(n_prompts)])
     bodies = [json.dumps(
-        {"prompt": rng.integers(0, vocab, prompt_len).tolist(),
-         "max_new_tokens": new_tokens,
+        {"prompt": p, "max_new_tokens": new_tokens,
          **({"stream": True} if stream else {})}).encode()
-        for _ in range(max(1, n_threads * reqs_each // 2))]
+        for p in prompts]
 
     def client(k):
         for i in range(reqs_each):
@@ -383,6 +421,9 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
             with open(trace_out, "w") as fh:
                 json.dump(trace, fh)
         tp_used = getattr(srv._decoder, "tp", 1)  # before stop() drops it
+        tier_census = (srv._decoder.tier.stats()
+                       if getattr(srv._decoder, "tier", None) is not None
+                       else None)
     finally:
         srv.stop()
     assert not errors, errors
@@ -418,6 +459,13 @@ def main_generate(n_threads=4, reqs_each=4, prompt_len=48, new_tokens=12,
                   f"{t['decode_ms']:.1f}")
         # client-side percentile + phase table (cross-check against the
         # server's SLO monitor: GET /metrics slo_route_p99_ms)
+        if tier_census is not None:
+            h, d = tier_census["host"], tier_census["disk"]
+            print(f"kv tiers:   host {h['blocks']} blocks "
+                  f"({h['bytes'] / 1e6:.2f}MB of "
+                  f"{h['budget_bytes'] / 1e6:.0f}MB), disk "
+                  f"{d['blocks']} blocks, directory "
+                  f"{tier_census['directory_entries']} entries")
         print_timing_table(summarize_timings(results))
         # client-observed vs server-observed latency: the difference is
         # the HTTP/network/accept-queue gap BETWEEN the tiers — exactly
@@ -563,6 +611,13 @@ def main_fleet(n_replicas=2, n_threads=4, reqs_each=8, prompt_len=48,
               f"{journal['failed_total']} failed, "
               f"{journal['duplicate_finishes_suppressed']} dup-"
               "suppressed")
+        if tier_census is not None:
+            h, d = tier_census["host"], tier_census["disk"]
+            print(f"kv tiers:   host {h['blocks']} blocks "
+                  f"({h['bytes'] / 1e6:.2f}MB of "
+                  f"{h['budget_bytes'] / 1e6:.0f}MB), disk "
+                  f"{d['blocks']} blocks, directory "
+                  f"{tier_census['directory_entries']} entries")
         print_timing_table(summarize_timings(results))
         lost = journal["accepted_total"] - journal["finished_total"] \
             - journal["failed_total"]
@@ -629,6 +684,21 @@ if __name__ == "__main__":
                          "tensor-parallel over N devices (forces an "
                          "N-device virtual CPU mesh when needed) and "
                          "report tokens/s")
+    ap.add_argument("--zipf", type=int, default=0,
+                    help="with --generate: draw prompts as a "
+                         "zipf-distributed mix over K users' shared "
+                         "prefixes (hot users repeat; exercises the "
+                         "prefix cache / KV tiers) instead of uniform "
+                         "~2x repeats")
+    ap.add_argument("--zipf-s", type=float, default=1.1,
+                    help="zipf skew exponent (higher = hotter head)")
+    ap.add_argument("--prefix-len", type=int, default=None,
+                    help="shared-prefix tokens per zipf user "
+                         "(default: 2/3 of the prompt)")
+    ap.add_argument("--host-cache-mb", type=float, default=0.0,
+                    help="with --generate: serve from a paged pool "
+                         "with hierarchical KV tiering (host ring of "
+                         "this budget) and print the tier census")
     ap.add_argument("--stream", action="store_true",
                     help="with --generate: request SSE token streams "
                          "and report client-measured TTFT in the phase "
@@ -645,7 +715,9 @@ if __name__ == "__main__":
     elif a.generate:
         main_generate(n_threads=a.threads, reqs_each=a.requests,
                       trace_out=a.trace_out, mesh=a.mesh,
-                      stream=a.stream)
+                      stream=a.stream, zipf=a.zipf, zipf_s=a.zipf_s,
+                      prefix_len=a.prefix_len,
+                      host_cache_mb=a.host_cache_mb)
     else:
         main(n_threads=a.threads, reqs_each=a.requests, rows=a.rows,
              compare=a.compare)

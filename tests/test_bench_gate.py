@@ -275,3 +275,55 @@ def test_paged_decode_kernel_healthy_rows_pass():
     tpu = {"paged_decode_kernel": {"outputs_identical": 1,
                                    "engaged_ratio": 1.42}}
     assert bench.check_floors(tpu) == []
+
+
+def test_kv_tiering_regressions_are_caught():
+    """ISSUE 19 acceptance floors: the tiered engine's zipf hit rate
+    must strictly exceed HBM-only (ratio >= 1.05), TTFT steps must drop
+    (ratio <= 0.95), decode-phase step time must stay within 5% of the
+    HBM-only engine (spill/restore rides the background thread, never
+    the decode path), and outputs must stay token-identical — each
+    failure mode trips the gate independently."""
+    no_gain = {"kv_tiering": {"hit_rate_ratio": 1.0,
+                              "ttft_steps_ratio": 0.88,
+                              "step_time_ratio": 1.0,
+                              "outputs_identical": 1}}
+    regs = bench.check_floors(no_gain)
+    assert any("hit_rate_ratio" in r for r in regs), regs
+
+    slow_ttft = {"kv_tiering": {"hit_rate_ratio": 1.2,
+                                "ttft_steps_ratio": 1.1,
+                                "step_time_ratio": 1.0,
+                                "outputs_identical": 1}}
+    regs = bench.check_floors(slow_ttft)
+    assert any("ttft_steps_ratio" in r for r in regs), regs
+
+    blocked_decode = {"kv_tiering": {"hit_rate_ratio": 1.2,
+                                     "ttft_steps_ratio": 0.88,
+                                     "step_time_ratio": 0.7,
+                                     "outputs_identical": 1}}
+    regs = bench.check_floors(blocked_decode)
+    assert any("step_time_ratio=0.7 < floor" in r for r in regs), regs
+
+    divergent = {"kv_tiering": {"hit_rate_ratio": 1.2,
+                                "ttft_steps_ratio": 0.88,
+                                "step_time_ratio": 1.0,
+                                "outputs_identical": 0}}
+    regs = bench.check_floors(divergent)
+    assert any("outputs_identical" in r for r in regs), regs
+
+    renamed = {"kv_tiering": {"hit_rate_ratio": 1.2,
+                              "ttft_steps_ratio": 0.88,
+                              "outputs_identical": 1}}
+    regs = bench.check_floors(renamed)
+    assert any("step_time_ratio missing" in r for r in regs), regs
+
+
+def test_kv_tiering_healthy_row_passes():
+    # the measured CPU row (BENCH_LOCAL.json): tiering wins hits and
+    # TTFT on the zipf mix without touching decode step time
+    healthy = {"kv_tiering": {"hit_rate_ratio": 1.1114,
+                              "ttft_steps_ratio": 0.8837,
+                              "step_time_ratio": 1.0941,
+                              "outputs_identical": 1}}
+    assert bench.check_floors(healthy) == []

@@ -1,0 +1,607 @@
+"""Hierarchical KV cache tiering + fleet prefix directory (ISSUE 19).
+
+The acceptance contract: LRU evictions of unreferenced prefix blocks
+demote to a pinned host-RAM ring (then a durable.py-framed disk store)
+instead of vanishing, promote back through the zero-copy adopt/
+table-remap path, and the whole ladder NEVER changes tokens — greedy
+and seeded-sampled outputs stay identical to solo decoding with the
+tier on, off, under injected spill/restore faults, and across an
+engine crash. The trie lifts fleet-wide: replicas publish block-hash
+chains to a directory feed, a peer pulls a chain over HTTP and serves
+the prefix with ZERO recompute (counter-asserted), and the router
+routes repeats to the replica that already holds the blocks.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.inference import (DecodeScheduler, MetricsRegistry,
+                                          failpoints)
+from deeplearning4j_tpu.inference.kvtier import (TIER_LEDGER_KINDS,
+                                                 TierManager, chain_hash,
+                                                 decode_block, encode_block,
+                                                 prompt_chain)
+from deeplearning4j_tpu.models.sampling import generate_transformer
+from deeplearning4j_tpu.models.zoo import transformer_lm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+V = 13
+B = 8  # kv_block everywhere in this file
+
+
+def _lm(cache=96):
+    conf = transformer_lm(vocab_size=V, d_model=16, n_heads=2,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = cache
+    return ComputationGraph(conf).init()
+
+
+# 2 layers x (k+v) x Hkv2 x Dh8 x f32 = 256 bytes per cache position
+def _pool_mb(blocks, block=B):
+    return (blocks + 1) * block * 256 / float(1 << 20)
+
+
+def _settle(eng, timeout=10.0):
+    """Wait for the tier worker + scheduler tick to drain (spills
+    landed, promotions integrated)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = eng.tier.stats()
+        if not any(st["queues"].values()):
+            return st
+        time.sleep(0.01)
+    raise AssertionError(f"tier never drained: {eng.tier.stats()}")
+
+
+def _mk_engine(host_mb=4.0, pool_blocks=12, slots=2, **kw):
+    return DecodeScheduler(
+        _lm(), V, n_slots=slots, prefill_chunk=16, kv_block=B,
+        kv_pool_mb=_pool_mb(pool_blocks), host_cache_mb=host_mb,
+        metrics=MetricsRegistry(), transfer_guard="disallow",
+        **kw).start()
+
+
+def _fake_pages(seed):
+    rng = np.random.default_rng(seed)
+    return {"layer0": {"k_pages": rng.standard_normal((2, B, 4),
+                                                      dtype=np.float32),
+                       "v_pages": rng.standard_normal((2, B, 4),
+                                                      dtype=np.float32)}}
+
+
+# --------------------------------------------------- chain hashing ------
+def test_chain_hash_deterministic_and_prefix_sensitive():
+    k1, k2 = (1, 2, 3), (4, 5, 6)
+    h1 = chain_hash("", k1)
+    assert h1 == chain_hash("", k1)           # deterministic
+    assert h1 != chain_hash("", k2)           # key-sensitive
+    assert chain_hash(h1, k2) != chain_hash("", k2)  # parent-sensitive
+
+    chain = prompt_chain([1, 2, 3, 4, 5, 6], 3)
+    assert chain == [chain_hash("", k1), chain_hash(chain_hash("", k1), k2)]
+    # only FULL blocks hash (a partial tail block is never shared)
+    assert prompt_chain([1, 2, 3, 4], 3) == [chain_hash("", k1)]
+
+
+def test_block_payload_roundtrip_and_corruption_rejected():
+    from deeplearning4j_tpu.inference.kvtier import TierEntry
+    e = TierEntry(hash=chain_hash("", (1, 2)), parent="", key=(1, 2),
+                  depth=1, prefix=(1, 2), tier="host")
+    pages = _fake_pages(3)
+    payload = encode_block(e, pages)
+    meta, out = decode_block(payload)
+    assert meta["hash"] == e.hash and meta["prefix"] == [1, 2]
+    np.testing.assert_array_equal(out["layer0"]["k_pages"],
+                                  pages["layer0"]["k_pages"])
+    assert decode_block(payload[:-3]) is None       # truncated
+    assert decode_block(b"garbage" + payload) is None  # bad frame
+
+
+# ------------------------------------------- TierManager standalone -----
+def test_tier_manager_spill_lookup_restore_cycle():
+    tm = TierManager(host_bytes=1 << 20, metrics=MetricsRegistry())
+    try:
+        toks = list(range(2 * B))
+        chain = prompt_chain(toks, B)
+        tm.attach_engine(lambda bid: _fake_pages(bid), 2 * B * 4 * 4, B)
+        tm.note_resident(chain[0], "", tuple(toks[:B]))
+        tm.note_resident(chain[1], chain[0], tuple(toks[B:]))
+        tm.offer_spill(chain[0], 1)
+        tm.offer_spill(chain[1], 2)
+        tm.pace(1 << 20)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if tm.stats()["host"]["blocks"] == 2:
+                break
+            time.sleep(0.01)
+        assert tm.stats()["host"]["blocks"] == 2
+        # the spilled chain is visible to admission-time lookups
+        assert tm.lookup_extension("", toks, 0, 8) == chain
+        assert tm.request_restore(chain) == 2
+        tm.pace(1 << 20)
+        got = []
+        deadline = time.monotonic() + 5
+        while len(got) < 2 and time.monotonic() < deadline:
+            got.extend(tm.drain_ready(1 << 20))
+            time.sleep(0.01)
+        # chain order: the parent must integrate before the child
+        assert [e.hash for e, _ in got] == chain
+        np.testing.assert_array_equal(got[0][1]["layer0"]["k_pages"],
+                                      _fake_pages(1)["layer0"]["k_pages"])
+        for h in chain:
+            tm.promotion_done(h, True)
+    finally:
+        tm.stop()  # ledger check inside
+
+
+def test_host_ring_lru_demotes_to_disk_and_torn_file_is_a_miss(tmp_path):
+    """Host overflow demotes the LRU block to CRC-framed disk files; a
+    torn file is a MISS (entry dropped, restore_failed counted), never
+    bad pages."""
+    m = MetricsRegistry()
+    pages = _fake_pages(0)
+    nbytes = sum(a.nbytes for lk in pages.values() for a in lk.values())
+    # host budget fits exactly ONE block: the second spill evicts the
+    # first into the disk store
+    tm = TierManager(host_bytes=nbytes + 16, disk_bytes=1 << 20,
+                     disk_dir=str(tmp_path), metrics=m)
+    try:
+        toks = list(range(2 * B))
+        chain = prompt_chain(toks, B)
+        tm.attach_engine(lambda bid: _fake_pages(bid), nbytes, B)
+        tm.note_resident(chain[0], "", tuple(toks[:B]))
+        tm.note_resident(chain[1], chain[0], tuple(toks[B:]))
+        tm.pace(1 << 20)
+        tm.offer_spill(chain[0], 1)
+        tm.offer_spill(chain[1], 2)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            st = tm.stats()
+            if st["disk"]["blocks"] == 1 and st["host"]["blocks"] == 1:
+                break
+            time.sleep(0.01)
+        st = tm.stats()
+        assert (st["host"]["blocks"], st["disk"]["blocks"]) == (1, 1)
+        assert m.counter("kv_tier_demoted_disk_blocks_total").value == 1
+        files = list(tmp_path.glob("*.kvb"))
+        assert len(files) == 1
+        # tear the on-disk frame: the next restore must degrade, not
+        # deserialize garbage
+        files[0].write_bytes(files[0].read_bytes()[:-5])
+        tm.request_restore(chain)
+        tm.pace(1 << 20)
+        deadline = time.monotonic() + 5
+        got = []
+        while time.monotonic() < deadline:
+            got.extend(tm.drain_ready(1 << 20))
+            if m.counter("kv_tier_restore_failed_total").value:
+                break
+            time.sleep(0.01)
+        assert m.counter("kv_tier_restore_failed_total").value >= 1
+        # the torn block's entry is gone; the host-held block (whichever
+        # chain position survived in RAM) still restores
+        assert all(e.hash in chain for e, _ in got)
+        for e, _ in got:
+            tm.promotion_done(e.hash, True)
+    finally:
+        tm.stop(check=False)  # torn-file drop already released its ledger
+
+
+# ------------------------------------------------ engine round trip -----
+def test_spill_promote_roundtrip_token_identical_greedy():
+    """Prompts evicted under pool pressure come back from the host ring
+    via table remap: repeats hit the tier, outputs stay identical to
+    solo decoding, and TTFT steps drop on the tiered repeat."""
+    net = _lm()
+    rng = np.random.default_rng(5)
+    prompts = [[int(x) for x in rng.integers(0, V, 41)] for _ in range(3)]
+    solo = [generate_transformer(net, p, 6, V, use_cache=True)
+            for p in prompts]
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_block=B, kv_pool_mb=_pool_mb(12),
+                          host_cache_mb=4.0, metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
+    try:
+        m = eng.metrics
+        cold = [eng.submit(p, 6) for p in prompts]
+        assert [h.result(120) for h in cold] == solo
+        _settle(eng)
+        assert m.counter("kv_tier_spilled_blocks_total").value > 0
+        warm = []
+        for p in prompts:  # sequential: each repeat sees the tier
+            warm.append(eng.submit(p, 6).result(120))
+            _settle(eng)
+        assert warm == solo
+        assert m.counter("kv_tier_promoted_blocks_total").value > 0
+        assert m.counter("kv_tier_hits_host_total").value > 0
+        assert m.counter("kv_tier_restore_failed_total").value == 0
+    finally:
+        eng.stop()
+
+
+def test_seeded_sampling_through_tier_matches_solo():
+    net = _lm()
+    prompt = [int(x) for x in np.random.default_rng(1).integers(0, V, 41)]
+    kw = dict(temperature=0.8, top_k=5, top_p=0.9, seed=11)
+    solo = generate_transformer(net, prompt, 6, V, use_cache=True, **kw)
+    filler = [[int(x) for x in np.random.default_rng(s).integers(0, V, 41)]
+              for s in (2, 3)]
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_block=B, kv_pool_mb=_pool_mb(12),
+                          host_cache_mb=4.0, metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
+    try:
+        assert eng.generate(prompt, 6, timeout=120, **kw) == solo
+        for f in filler:  # push the prompt's blocks out of HBM
+            eng.generate(f, 6, timeout=120)
+        _settle(eng)
+        assert eng.generate(prompt, 6, timeout=120, **kw) == solo
+        assert eng.metrics.counter(
+            "kv_tier_promoted_blocks_total").value > 0
+    finally:
+        eng.stop()
+
+
+def test_tier_roundtrip_token_identical_tp2():
+    """The spill/promote path composes with the tensor-parallel mesh
+    (head-sharded pool): tp=2 outputs stay identical to solo through a
+    tier round trip (conftest forces the 8-device virtual CPU mesh)."""
+    conf = transformer_lm(vocab_size=V, d_model=32, n_heads=4,
+                          n_blocks=2, rope=True)
+    for vert in conf.vertices.values():
+        layer = getattr(vert, "layer", None)
+        if layer is not None and hasattr(layer, "max_cache_len"):
+            layer.max_cache_len = 96
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(7)
+    prompts = [[int(x) for x in rng.integers(0, V, 41)] for _ in range(3)]
+    solo = [generate_transformer(net, p, 6, V, use_cache=True)
+            for p in prompts]
+    # 512 B/position total, split over tp=2 -> 256 B/device
+    pool_mb = 13 * B * 512 / 2 / float(1 << 20)
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_block=B, kv_pool_mb=pool_mb,
+                          host_cache_mb=4.0, mesh=2,
+                          metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
+    try:
+        assert eng.tp == 2 and eng.tier is not None
+        assert [eng.submit(p, 6).result(240) for p in prompts] == solo
+        _settle(eng)
+        warm = []
+        for p in prompts:
+            warm.append(eng.submit(p, 6).result(240))
+            _settle(eng)
+        assert warm == solo
+        assert eng.metrics.counter(
+            "kv_tier_promoted_blocks_total").value > 0
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------- failure injection ----
+def test_spill_fault_degrades_to_cold_prefill_token_identical():
+    """An injected crash on the tier.spill seam loses the SPILL, never
+    a token: the block drops from the directory, repeats re-prefill
+    cold, outputs stay identical."""
+    net = _lm()
+    rng = np.random.default_rng(9)
+    prompts = [[int(x) for x in rng.integers(0, V, 41)] for _ in range(3)]
+    solo = [generate_transformer(net, p, 6, V, use_cache=True)
+            for p in prompts]
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_block=B, kv_pool_mb=_pool_mb(12),
+                          host_cache_mb=4.0, metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
+    failpoints.arm("tier.spill", "crash@always")
+    try:
+        outs = []
+        for p in prompts + prompts:
+            outs.append(eng.submit(p, 6).result(120))
+        assert outs == solo + solo
+        m = eng.metrics
+        assert m.counter("kv_tier_spill_dropped_total").value > 0
+        assert m.counter("kv_tier_spilled_blocks_total").value == 0
+    finally:
+        failpoints.disarm()
+        eng.stop()
+
+
+def test_restore_fault_degrades_to_cold_prefill_token_identical():
+    """An injected crash on tier.restore (the worker-side seam) counts
+    a restore failure and the request prefills cold — same tokens."""
+    net = _lm()
+    rng = np.random.default_rng(9)
+    prompts = [[int(x) for x in rng.integers(0, V, 41)] for _ in range(3)]
+    solo = [generate_transformer(net, p, 6, V, use_cache=True)
+            for p in prompts]
+    eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                          kv_block=B, kv_pool_mb=_pool_mb(12),
+                          host_cache_mb=4.0, metrics=MetricsRegistry(),
+                          transfer_guard="disallow").start()
+    try:
+        assert [eng.submit(p, 6).result(120) for p in prompts] == solo
+        _settle(eng)
+        failpoints.arm("tier.restore", "crash@always")
+        try:
+            outs = []
+            for p in prompts:
+                outs.append(eng.submit(p, 6).result(120))
+        finally:
+            failpoints.disarm()
+        assert outs == solo
+        assert eng.metrics.counter(
+            "kv_tier_restore_failed_total").value > 0
+    finally:
+        failpoints.disarm()
+        eng.stop()
+
+
+def test_publish_fault_drops_the_event_not_the_state():
+    m = MetricsRegistry()
+    tm = TierManager(host_bytes=1 << 20, metrics=m)
+    failpoints.arm("directory.publish", "crash@always")
+    try:
+        h = chain_hash("", tuple(range(B)))
+        tm.note_resident(h, "", tuple(range(B)))
+        assert m.counter("kv_tier_publish_dropped_total").value >= 1
+        assert tm.directory_feed(0)["events"] == [] or all(
+            ev["hash"] == h for ev in tm.directory_feed(0)["events"])
+        assert tm.holds(h)  # the entry itself survived the lost event
+    finally:
+        failpoints.disarm()
+        tm.stop(check=False)
+
+
+def test_engine_crash_mid_tiering_recovers_token_identical():
+    """The SIGKILL-equivalent chaos pass: a supervised engine with live
+    spill traffic is crashed by the decode-dispatch seam, fenced (tier
+    worker stopped uncheck'd), rebuilt, and every in-flight request
+    replays token-identically — the tier loses blocks, never tokens."""
+    from deeplearning4j_tpu.serving.server import InferenceServer
+    net = _lm()
+    rng = np.random.default_rng(13)
+    prompts = [[int(x) for x in rng.integers(0, V, 41)] for _ in range(3)]
+    srv = InferenceServer(net=net, decode_vocab=V, decode_slots=2,
+                          prefill_chunk=16, kv_block=B,
+                          kv_pool_mb=_pool_mb(12), host_cache_mb=4.0,
+                          hang_timeout_s=10.0, retry_budget=6).start()
+    srv.supervisor.poll_interval_s = 0.02
+    srv.supervisor.backoff_base_s = 0.01
+    srv.supervisor.backoff_max_s = 0.1
+    def post(p, retries=20):
+        body = json.dumps({"prompt": p, "max_new_tokens": 6}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        for i in range(retries):
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return json.loads(r.read().decode())
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.2)
+        raise AssertionError("request never completed across restarts")
+
+    try:
+        expected = [generate_transformer(net, p, 6, V, use_cache=True)
+                    for p in prompts]
+        # warm pass seeds the tier with spilled blocks
+        assert [post(p)["tokens"] for p in prompts] == expected
+        failpoints.arm("dispatch.decode", "crash@once")
+        try:
+            got = [post(p)["tokens"] for p in prompts]
+        finally:
+            failpoints.disarm()
+        assert got == expected
+    finally:
+        failpoints.disarm()
+        srv.stop()
+
+
+# ----------------------------------------------------- resource ledger --
+def test_tier_ledger_balances_spill_restore_free():
+    """graftleak over the full spill -> demote -> restore -> stop cycle:
+    every host_page / disk_block / directory_entry acquired is released
+    (disk files persist by design; the ledger tracks in-process
+    ownership)."""
+    from deeplearning4j_tpu.analysis import resource_ledger
+    net = _lm()
+    rng = np.random.default_rng(5)
+    prompts = [[int(x) for x in rng.integers(0, V, 41)] for _ in range(3)]
+    with resource_ledger() as led:
+        eng = DecodeScheduler(net, V, n_slots=2, prefill_chunk=16,
+                              kv_block=B, kv_pool_mb=_pool_mb(12),
+                              host_cache_mb=4.0,
+                              metrics=MetricsRegistry(),
+                              transfer_guard="disallow").start()
+        try:
+            for p in prompts + prompts:
+                eng.submit(p, 6).result(120)
+                _settle(eng)
+        finally:
+            eng.stop()
+    led.assert_clean()
+
+
+def test_lifecycle_registry_has_tier_kinds_as_ledger_only():
+    from deeplearning4j_tpu.analysis.lifecycle import REGISTRY
+    kinds = {s.kind: s for s in REGISTRY}
+    for k in TIER_LEDGER_KINDS:
+        assert k in kinds, k
+        assert kinds[k].ledger_only, k
+
+
+# ------------------------------------------ HTTP: directory + fetch -----
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.read()
+
+
+def _post(port, path, obj, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _serving_pair(net):
+    from deeplearning4j_tpu.serving.server import InferenceServer
+    mk = lambda: InferenceServer(  # noqa: E731
+        net=net, decode_vocab=V, decode_slots=2, prefill_chunk=16,
+        kv_block=B, kv_pool_mb=_pool_mb(12), host_cache_mb=4.0,
+        supervise=False).start()
+    return mk(), mk()
+
+
+def test_cross_replica_fetch_restores_with_zero_recompute():
+    """Prefix computed on replica A, served on replica B after a
+    /prefix/fetch peer pull: B prefills ONLY the partial tail block —
+    counter-asserted, not eyeballed — and emits A's exact tokens."""
+    net = _lm()
+    prompt = [int(x) for x in np.random.default_rng(3).integers(0, V, 41)]
+    a, b = _serving_pair(net)
+    try:
+        ra = _post(a.port, "/generate",
+                   {"prompt": prompt, "max_new_tokens": 6})
+        feed = json.loads(_get(a.port, "/prefix/directory?since=0"))
+        assert feed["reset"] and feed["events"]
+        evs = sorted(feed["events"], key=lambda e: e["depth"])
+        hashes = [e["hash"] for e in evs]
+        assert hashes == prompt_chain(prompt, B)  # 5 full blocks
+        # raw block payload is servable and decodable
+        meta, _pages = decode_block(
+            _get(a.port, f"/prefix/block?hash={hashes[0]}", timeout=30))
+        assert meta["hash"] == hashes[0]
+        res = _post(b.port, "/prefix/fetch",
+                    {"peer": f"http://127.0.0.1:{a.port}",
+                     "hashes": hashes}, timeout=120)
+        assert res["fetched"] == len(hashes) and res["failed"] == 0
+        # wait for B's engine to integrate the promotions
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            snap = json.loads(_get(b.port, "/debug/engine"))
+            tier = snap.get("tier") or {}
+            mets = json.loads(_get(b.port, "/metrics"))
+            promoted = mets["counters"].get(
+                "kv_tier_promoted_blocks_total", 0)
+            if promoted >= len(hashes) and not any(
+                    tier.get("queues", {"x": 1}).values()):
+                break
+            time.sleep(0.05)
+        assert promoted == len(hashes), (promoted, tier)
+        pre0 = mets["counters"]["prefill_tokens_total"]
+        rb = _post(b.port, "/generate",
+                   {"prompt": prompt, "max_new_tokens": 6})
+        assert rb["tokens"] == ra["tokens"]
+        mets = json.loads(_get(b.port, "/metrics"))
+        prefilled = (mets["counters"]["prefill_tokens_total"] - pre0)
+        # zero recompute of the fetched chain: only the tokens past the
+        # last FULL block (41 - 40, clamped to >=1 for the last-token
+        # forward) may prefill on B
+        assert prefilled <= len(prompt) - len(hashes) * B + 1, prefilled
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_fetch_endpoint_validates_and_skips_held_blocks():
+    net = _lm()
+    prompt = [int(x) for x in np.random.default_rng(3).integers(0, V, 41)]
+    a, b = _serving_pair(net)
+    try:
+        _post(a.port, "/generate", {"prompt": prompt, "max_new_tokens": 4})
+        feed = json.loads(_get(a.port, "/prefix/directory?since=0"))
+        hashes = [e["hash"] for e in sorted(feed["events"],
+                                            key=lambda e: e["depth"])]
+        first = _post(b.port, "/prefix/fetch",
+                      {"peer": f"http://127.0.0.1:{a.port}",
+                       "hashes": hashes}, timeout=120)
+        assert first["fetched"] == len(hashes)
+        again = _post(b.port, "/prefix/fetch",
+                      {"peer": f"http://127.0.0.1:{a.port}",
+                       "hashes": hashes}, timeout=120)
+        assert again["skipped"] == len(hashes) and again["fetched"] == 0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(b.port, "/prefix/fetch", {"hashes": hashes})
+        assert ei.value.code == 400
+        # unknown hash: the peer 404s, the fetch reports the failure
+        bad = _post(b.port, "/prefix/fetch",
+                    {"peer": f"http://127.0.0.1:{a.port}",
+                     "hashes": ["deadbeef"]}, timeout=120)
+        assert bad["failed"] == 1 and bad["fetched"] == 0
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_directory_feed_cursor_tailing():
+    net = _lm()
+    a, b = _serving_pair(net)
+    b.stop()
+    try:
+        p1 = [int(x) for x in np.random.default_rng(1).integers(0, V, 17)]
+        _post(a.port, "/generate", {"prompt": p1, "max_new_tokens": 4})
+        feed = json.loads(_get(a.port, "/prefix/directory?since=0"))
+        assert feed["reset"]
+        cur = feed["next"]
+        # no new inserts: an incremental tail from the cursor is empty
+        feed2 = json.loads(_get(a.port,
+                                f"/prefix/directory?since={cur}"))
+        assert not feed2["reset"] and feed2["events"] == []
+        p2 = [int(x) for x in np.random.default_rng(2).integers(0, V, 17)]
+        _post(a.port, "/generate", {"prompt": p2, "max_new_tokens": 4})
+        feed3 = json.loads(_get(a.port,
+                                f"/prefix/directory?since={cur}"))
+        assert feed3["events"] and not feed3["reset"]
+        assert all(ev["seq"] > cur for ev in feed3["events"])
+    finally:
+        a.stop()
+
+
+# ------------------------------------------------- router integration ---
+@pytest.mark.slow
+def test_router_routes_repeat_to_the_replica_holding_the_prefix():
+    """Fleet path end to end: replica A serves a prompt and publishes
+    the chain; the router's directory poll ingests it; the repeat
+    through the router is a DIRECTORY hit routed to a holder, and the
+    fleet serves it token-identically."""
+    from deeplearning4j_tpu.serving.router import FleetRouter
+    net = _lm()
+    prompt = [int(x) for x in np.random.default_rng(3).integers(0, V, 41)]
+    a, b = _serving_pair(net)
+    router = None
+    try:
+        expected = _post(a.port, "/generate",
+                         {"prompt": prompt, "max_new_tokens": 6})
+        router = FleetRouter(
+            replica_urls=[f"http://127.0.0.1:{a.port}",
+                          f"http://127.0.0.1:{b.port}"],
+            kv_block=B, scrape_interval_s=0.1,
+            metrics=MetricsRegistry()).start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if router.metrics.gauge(
+                    "router_directory_entries").value >= 5:
+                break
+            time.sleep(0.05)
+        assert router.metrics.gauge(
+            "router_directory_entries").value >= 5
+        out = _post(router.port, "/generate",
+                    {"prompt": prompt, "max_new_tokens": 6}, timeout=120)
+        assert out["tokens"] == expected["tokens"]
+        assert router.metrics.counter(
+            "router_directory_hits_total").value >= 1
+    finally:
+        if router is not None:
+            router.stop(stop_replicas=False)
+        a.stop()
+        b.stop()

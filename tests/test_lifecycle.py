@@ -265,7 +265,8 @@ def test_lc004_every_path_terminal_is_clean(tmp_path):
 def test_registry_names_are_coherent():
     kinds = registry_kinds()
     assert {"trie_pin", "pool_block", "mask_row", "journal_record",
-            "engine_slot", "fork_ref", "stream"} == kinds
+            "engine_slot", "fork_ref", "stream",
+            "host_page", "disk_block", "directory_entry"} == kinds
     for spec in REGISTRY:
         if spec.ledger_only:
             assert not spec.acquire and not spec.release
